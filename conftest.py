@@ -1,0 +1,13 @@
+"""Test harness config: force an 8-device virtual CPU mesh before JAX loads.
+
+Mirrors the reference's "multi-node without a cluster" strategy (SURVEY §4.5:
+N gb processes on loopback) — here N virtual JAX CPU devices so the sharded
+query plane (shard_map over the mesh) is exercised without TPU hardware.
+Must run before any ``import jax`` in the test session.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")

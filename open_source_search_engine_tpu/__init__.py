@@ -1,0 +1,23 @@
+"""TPU-native search-engine framework with the capabilities of Gigablast.
+
+A ground-up re-design of ``cxcx/open-source-search-engine`` (Gigablast — a
+distributed crawler + LSM record store + positional inverted index + sharded
+query engine, reference at ``/root/reference``) for TPU hardware:
+
+* the host data plane (LSM store, document pipeline, crawler, control plane)
+  lives in :mod:`~open_source_search_engine_tpu.index`,
+  :mod:`~open_source_search_engine_tpu.build` and
+  :mod:`~open_source_search_engine_tpu.serve`;
+* the device query plane — Gigablast's ``PosdbTable::intersectLists10_r``
+  posting-list intersection and proximity scorer (reference
+  ``Posdb.cpp:5437``) behind the Msg39 RPC boundary — is a vmapped segmented
+  intersection + top-k in :mod:`~open_source_search_engine_tpu.ops`;
+* cross-shard scatter-gather (reference ``Msg3a.cpp:971``) is a
+  ``shard_map`` over a :class:`jax.sharding.Mesh` with an all-gather top-k
+  merge in :mod:`~open_source_search_engine_tpu.parallel`.
+
+The package directory uses underscores (``open_source_search_engine_tpu``)
+because Python module names cannot contain hyphens.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,9 @@
+"""Host-side index/storage layer.
+
+Reference layers L1 (the Rdb LSM engine, ``Rdb.cpp``/``RdbTree``/``RdbList``
+/``RdbMerge``) and L3 (the named databases with key schemas: ``Posdb``,
+``Titledb``, ``Clusterdb``, ``Linkdb``, ``Tagdb``, ``Spiderdb`` — SURVEY
+§2.2/§2.3). On TPU the storage engine stays on the host (numpy + optional
+C++ core in ``native/``); posting lists are packed out of it into padded
+device arrays by :mod:`~open_source_search_engine_tpu.ops.pack`.
+"""

@@ -1,0 +1,9 @@
+"""Core runtime utilities (reference layer L0: Loop/Mem/Log/SafeBuf/types).
+
+The reference's L0 is a single-threaded event loop plus hand-rolled memory
+and file layers (``Loop.cpp``, ``Mem.cpp``, ``BigFile.cpp``). On the TPU
+build the host runtime is ordinary Python/asyncio + numpy, so this package
+only carries the pieces with real semantic content: the typed parameter
+registry (``Parms.cpp`` equivalent), the 64-bit term hash, logging, and URL
+handling.
+"""

@@ -1,0 +1,81 @@
+"""64-bit term hashing (reference: ``hash.h`` / ``hash.cpp`` ``hash64``).
+
+The reference hashes lower-cased words with a table-driven 64-bit mix and
+derives the 48-bit posdb termId from it (``XmlDoc.cpp:hashAll``; termId is
+the low 48 bits, ``Posdb.h`` termId field). We use our own stateless
+FNV-1a-64 variant with an avalanche finalizer — the exact hash function is
+an internal detail (only stability within one index matters), but the
+*shape* (word → 64-bit → 48-bit termId, prefix-salted field hashes) mirrors
+the reference.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_MASK64 = 0xFFFFFFFFFFFFFFFF
+
+TERMID_BITS = 48
+TERMID_MASK = (1 << TERMID_BITS) - 1
+
+
+def hash64(data: bytes | str, seed: int = 0) -> int:
+    """FNV-1a 64-bit with a murmur-style finalizer."""
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    h = (_FNV_OFFSET ^ seed) & _MASK64
+    for b in data:
+        h ^= b
+        h = (h * _FNV_PRIME) & _MASK64
+    # finalizer for better avalanche on short keys
+    h ^= h >> 33
+    h = (h * 0xFF51AFD7ED558CCD) & _MASK64
+    h ^= h >> 33
+    h = (h * 0xC4CEB9FE1A85EC53) & _MASK64
+    h ^= h >> 33
+    return h
+
+
+def term_id(word: str, prefix: str | None = None) -> int:
+    """48-bit termId for a word, optionally field-prefixed.
+
+    Mirrors the reference's prefixed-field hashing (``hashString`` with a
+    prefix hash for e.g. ``site:``/``inurl:`` terms, ``XmlDoc.cpp:hashAll``):
+    the prefix hash is mixed into the word hash so ``site:foo.com`` and the
+    plain body word occupy distinct termId spaces.
+    """
+    h = hash64(word.lower())
+    if prefix:
+        h = hash64(prefix, seed=h)
+    return h & TERMID_MASK
+
+
+def bigram_id(w1: str, w2: str) -> int:
+    """termId of the bigram "w1 w2" (reference: ``Phrases.cpp`` two-word
+    phrase hashing — a combined hash of the two word hashes)."""
+    return hash64(w2.lower(), seed=hash64(w1.lower())) & TERMID_MASK
+
+
+def doc_id(url: str) -> int:
+    """38-bit docId from a normalized URL.
+
+    The reference derives a 38-bit "probable docid" from the URL hash
+    (``Titledb.h`` ``getProbableDocId``: hash96 of URL masked by
+    ``DOCID_MASK`` = 38 bits). Same shape here; collision resolution is the
+    caller's job, as in the reference.
+    """
+    return hash64(url) & ((1 << 38) - 1)
+
+
+def hash64_array(arr: np.ndarray, seed: int = 0) -> np.ndarray:
+    """Vectorized 64-bit avalanche over a uint64 array (for key→shard maps)."""
+    with np.errstate(over="ignore"):  # modular 2^64 wraparound is the point
+        h = arr.astype(np.uint64) ^ np.uint64(seed)
+        h ^= h >> np.uint64(33)
+        h *= np.uint64(0xFF51AFD7ED558CCD)
+        h ^= h >> np.uint64(33)
+        h *= np.uint64(0xC4CEB9FE1A85EC53)
+        h ^= h >> np.uint64(33)
+    return h

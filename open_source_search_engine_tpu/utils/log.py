@@ -1,0 +1,51 @@
+"""Typed, runtime-togglable logging (reference: ``Log.cpp/h``).
+
+Gigablast logs carry a type ("query:", "spider:", "rdb:", ...) and each type
+can be toggled at runtime from the admin Log page. We reproduce that on top
+of :mod:`logging`: one logger per subsystem under the ``osse`` root, with a
+registry that the admin API can flip.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_ROOT = "osse"
+
+#: Log types mirroring the reference's log-subtype table
+#: (``html/developer.html``; ``Log.h``).
+LOG_TYPES = (
+    "query", "spider", "build", "rdb", "net", "admin", "speller",
+    "repair", "perf", "topics", "udp", "http", "dns", "mem",
+)
+
+_configured = False
+
+
+def _configure() -> None:
+    global _configured
+    if _configured:
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(
+        logging.Formatter("%(asctime)s %(name)s %(levelname).1s %(message)s")
+    )
+    root = logging.getLogger(_ROOT)
+    root.addHandler(handler)
+    root.setLevel(logging.INFO)
+    _configured = True
+
+
+def get_logger(log_type: str = "admin") -> logging.Logger:
+    """Return the logger for a subsystem log type (e.g. ``"query"``)."""
+    _configure()
+    return logging.getLogger(f"{_ROOT}.{log_type}")
+
+
+def set_log_type_enabled(log_type: str, enabled: bool) -> None:
+    """Runtime toggle for one log type — the reference's Log admin page."""
+    _configure()
+    logging.getLogger(f"{_ROOT}.{log_type}").setLevel(
+        logging.DEBUG if enabled else logging.WARNING
+    )

@@ -1,0 +1,153 @@
+"""Typed parameter registry — the single source of truth for every knob.
+
+Reference: ``Parms.cpp/h`` (23k LoC). One declarative ``Parm[]`` table maps
+each parameter to its cgi name, xml tag, type, object offset, default and
+flags; the table drives (a) config-file load/save, (b) the admin UI, (c) the
+URL API, and (d) cluster-wide live parameter broadcast from host0
+(``Parms.h:497`` ``broadcastParmList``, msgType 0x3f ``Parms.cpp:21683``).
+
+Here the same single-table idea: :data:`PARMS` declares every parameter
+once; :class:`Conf` (global scope, reference ``Conf.h:49`` / ``gb.conf``)
+and :class:`CollectionConf` (per-collection, reference ``coll.conf`` /
+``CollectionRec``) are dict-backed objects generated from it, with JSON
+round-trip and an ``on_update`` hook the control plane uses to broadcast
+changes to every host (serve.parm_sync).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable
+
+GLOBAL = "global"
+COLL = "coll"
+
+
+@dataclass(frozen=True)
+class Parm:
+    """One row of the parameter table (reference ``Parms.h`` ``class Parm``)."""
+
+    name: str              # python/config attribute name
+    cgi: str               # URL-api query parameter name
+    type: type             # bool / int / float / str
+    default: Any
+    scope: str             # GLOBAL (gb.conf) or COLL (coll.conf)
+    desc: str = ""
+    # reference PF_REBUILD/PF_NOSYNC-style flags
+    broadcast: bool = True  # sync to cluster on change (0x3f equivalent)
+
+
+def _p(name, cgi, typ, default, scope, desc="", broadcast=True) -> Parm:
+    return Parm(name, cgi, typ, default, scope, desc, broadcast)
+
+
+#: The parameter table. Kept deliberately small-but-real for round 1; grows
+#: alongside features. Reference rows cited per entry.
+PARMS: list[Parm] = [
+    # --- global (Conf.h / gb.conf) ---
+    _p("http_port", "hport", int, 8000, GLOBAL, "HTTP serving port (hosts.conf port column)"),
+    _p("max_mem", "maxmem", int, 4 << 30, GLOBAL, "memory budget per instance (Conf::m_maxMem, Mem.cpp:255)"),
+    _p("num_shards", "nshards", int, 1, GLOBAL, "index shards == mesh size (hosts.conf 'index-splits:')"),
+    _p("num_mirrors", "nmirrors", int, 0, GLOBAL, "replicas per shard (hosts.conf 'num-mirrors:', Hostdb.cpp:336)"),
+    _p("working_dir", "wdir", str, "./data", GLOBAL, "data directory (hosts.conf 'working-dir:')"),
+    _p("autosave_minutes", "autosave", int, 5, GLOBAL, "autosave frequency (Process.cpp:1299)"),
+    _p("spider_enabled", "se", bool, True, GLOBAL, "master spider switch (Conf::m_spideringEnabled)"),
+    _p("query_max_terms", "qmax", int, 64, GLOBAL, "max query terms (reference ABS_MAX_QUERY_TERMS=9000, Query.h:43; ours is the padded device width)"),
+    _p("dns_servers", "dns", str, "", GLOBAL, "DNS resolver ips (Conf dns parms)"),
+    _p("merge_quiet_hours", "mergehours", str, "", GLOBAL, "DailyMerge window (DailyMerge.h:11)"),
+    # --- per-collection (coll.conf / CollectionRec) ---
+    _p("docs_wanted", "n", int, 10, COLL, "results per page (SearchInput 'n')"),
+    _p("site_cluster", "sc", bool, True, COLL, "max-2-per-site clustering (Msg51/Clusterdb)"),
+    _p("dedup_results", "dr", bool, True, COLL, "content-hash dedup of results (Msg40)"),
+    _p("spider_max_pages", "maxpages", int, 0, COLL, "crawl page quota (CollectionRec::m_maxToCrawl)"),
+    _p("spider_delay_ms", "sdelay", int, 1000, COLL, "same-IP politeness wait (Spider.cpp wait tree)"),
+    _p("max_spiders", "maxspiders", int, 8, COLL, "concurrent fetches (Spider.h MAX_SPIDERS)"),
+    _p("lang_weight", "langw", float, 20.0, COLL, "same-language score boost (Posdb.cpp SAMELANGMULT)"),
+    _p("title_max_len", "tml", int, 80, COLL, "title truncation (Title.cpp)"),
+    _p("summary_excerpts", "ns", int, 3, COLL, "summary excerpt count (Summary.h)"),
+    _p("summary_max_len", "sml", int, 180, COLL, "summary length (Summary.h)"),
+]
+
+_BY_SCOPE: dict[str, dict[str, Parm]] = {GLOBAL: {}, COLL: {}}
+_BY_CGI: dict[str, Parm] = {}
+for parm in PARMS:
+    _BY_SCOPE[parm.scope][parm.name] = parm
+    _BY_CGI[parm.cgi] = parm
+
+
+class _ParmObject:
+    """Dict-backed object whose attributes are defined by the parm table."""
+
+    _scope: str = GLOBAL
+
+    def __init__(self, **overrides: Any):
+        self._values: dict[str, Any] = {
+            p.name: p.default for p in _BY_SCOPE[self._scope].values()
+        }
+        self._listeners: list[Callable[[str, Any], None]] = []
+        for k, v in overrides.items():
+            self.set(k, v)
+
+    def __getattr__(self, name: str) -> Any:
+        values = object.__getattribute__(self, "_values")
+        if name in values:
+            return values[name]
+        raise AttributeError(name)
+
+    def set(self, name: str, value: Any, *, _from_sync: bool = False) -> None:
+        parm = _BY_SCOPE[self._scope].get(name)
+        if parm is None:
+            raise KeyError(f"unknown parm {name!r} in scope {self._scope}")
+        value = parm.type(value)
+        self._values[name] = value
+        if not _from_sync:
+            for fn in self._listeners:
+                fn(name, value)
+
+    def on_update(self, fn: Callable[[str, Any], None]) -> None:
+        """Register a live-update listener (the 0x3f broadcast hook)."""
+        self._listeners.append(fn)
+
+    def set_from_cgi(self, cgi: str, value: Any) -> None:
+        """URL-api update: ``&maxmem=...`` (reference Pages/Parms URL api)."""
+        parm = _BY_CGI.get(cgi)
+        if parm is None or parm.scope != self._scope:
+            raise KeyError(f"unknown cgi parm {cgi!r}")
+        if parm.type is bool and isinstance(value, str):
+            value = value not in ("0", "false", "False", "")
+        self.set(parm.name, value)
+
+    # --- config file round trip (gb.conf / coll.conf equivalent) ---
+    def to_dict(self) -> dict[str, Any]:
+        return dict(self._values)
+
+    def save(self, path: str | Path) -> None:
+        Path(path).write_text(json.dumps(self._values, indent=2, sort_keys=True))
+
+    def load(self, path: str | Path) -> None:
+        for k, v in json.loads(Path(path).read_text()).items():
+            if k in _BY_SCOPE[self._scope]:
+                self.set(k, v, _from_sync=True)
+
+
+class Conf(_ParmObject):
+    """Global config (reference ``Conf.h:49``, file ``gb.conf``)."""
+
+    _scope = GLOBAL
+
+
+class CollectionConf(_ParmObject):
+    """Per-collection config (reference ``CollectionRec``, file ``coll.conf``)."""
+
+    _scope = COLL
+
+    def __init__(self, name: str = "main", **overrides: Any):
+        super().__init__(**overrides)
+        self.__dict__["name"] = name
+
+
+def parm_table() -> list[Parm]:
+    """The full table — used by the admin UI to render parameter pages."""
+    return list(PARMS)

@@ -1,0 +1,97 @@
+"""URL parsing / normalization / site extraction.
+
+Reference: ``Url.cpp/h`` (2.6k LoC — parse, normalize, punycode),
+``Domains.cpp`` (TLD table), ``SiteGetter.cpp`` (site boundary detection:
+the "site" is normally the host, but can be a subdirectory for hosting
+domains). We use :mod:`urllib.parse` plus a compact multi-label-TLD list;
+IDN is handled by Python's built-in ``idna`` codec (reference:
+``Punycode.cpp``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from urllib.parse import urljoin, urlsplit, urlunsplit
+
+# common multi-label public suffixes (reference Domains.cpp carries the full
+# TLD table; extend as needed)
+_TWO_LABEL_TLDS = {
+    "co.uk", "org.uk", "ac.uk", "gov.uk", "co.jp", "ne.jp", "or.jp",
+    "com.au", "net.au", "org.au", "co.nz", "com.br", "com.cn", "com.mx",
+    "co.in", "co.kr", "com.tw", "com.sg", "co.za", "com.ar", "com.tr",
+}
+
+DEFAULT_PORTS = {"http": 80, "https": 443}
+
+
+@dataclass(frozen=True)
+class Url:
+    """Parsed, normalized URL (reference ``class Url``, ``Url.h``)."""
+
+    scheme: str
+    host: str
+    port: int
+    path: str
+    query: str
+
+    @property
+    def full(self) -> str:
+        netloc = self.host
+        if self.port != DEFAULT_PORTS.get(self.scheme):
+            netloc = f"{self.host}:{self.port}"
+        return urlunsplit((self.scheme, netloc, self.path, self.query, ""))
+
+    @property
+    def domain(self) -> str:
+        """Registrable domain: ``www.a.foo.co.uk`` → ``foo.co.uk``
+        (reference ``Url::getDomain`` via the Domains.cpp TLD walk)."""
+        labels = self.host.split(".")
+        if len(labels) <= 2:
+            return self.host
+        if ".".join(labels[-2:]) in _TWO_LABEL_TLDS and len(labels) >= 3:
+            return ".".join(labels[-3:])
+        return ".".join(labels[-2:])
+
+    @property
+    def site(self) -> str:
+        """Site boundary — host for now (reference ``SiteGetter.cpp`` can
+        pick subdirectory sites for hosting domains; tagdb can override)."""
+        return self.host
+
+    @property
+    def tld(self) -> str:
+        labels = self.host.split(".")
+        if ".".join(labels[-2:]) in _TWO_LABEL_TLDS:
+            return ".".join(labels[-2:])
+        return labels[-1] if labels else ""
+
+
+def normalize(raw: str, base: str | None = None) -> Url:
+    """Parse + normalize a URL (reference ``Url::set`` normalization rules:
+    lowercase scheme/host, strip fragment, default path "/", resolve
+    relative against base, IDN→punycode, strip default port)."""
+    if base:
+        raw = urljoin(base, raw)
+    parts = urlsplit(raw.strip())
+    scheme = (parts.scheme or "http").lower()
+    host = (parts.hostname or "").lower().rstrip(".")
+    try:
+        host = host.encode("idna").decode("ascii") if host else host
+    except UnicodeError:
+        pass
+    port = parts.port or DEFAULT_PORTS.get(scheme, 0)
+    path = parts.path or "/"
+    # collapse duplicate slashes, resolve . / .. segments
+    segs: list[str] = []
+    for seg in path.split("/"):
+        if seg in ("", "."):
+            continue
+        if seg == "..":
+            if segs:
+                segs.pop()
+            continue
+        segs.append(seg)
+    path = "/" + "/".join(segs) + ("/" if path.endswith("/") and segs else "")
+    if not segs:
+        path = "/"
+    return Url(scheme, host, port, path, parts.query)

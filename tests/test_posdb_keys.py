@@ -1,0 +1,117 @@
+"""Key codec tests — the model is the reference's ``rdbtest``/key unit tests
+(SURVEY §4.3) plus bit-level checks against ``Posdb.h:4-50``'s documented
+layout."""
+
+import numpy as np
+import pytest
+
+from open_source_search_engine_tpu.index import posdb
+
+
+def test_key_size_and_dtype():
+    assert posdb.KEY_DTYPE.itemsize == 18
+
+
+def test_pack_unpack_roundtrip_exhaustive_fields():
+    rng = np.random.default_rng(0)
+    n = 4096
+    fields = dict(
+        termid=rng.integers(0, 1 << 48, n, dtype=np.uint64),
+        docid=rng.integers(0, 1 << 38, n, dtype=np.uint64),
+        wordpos=rng.integers(0, posdb.MAXWORDPOS + 1, n, dtype=np.uint64),
+        densityrank=rng.integers(0, 32, n, dtype=np.uint64),
+        diversityrank=rng.integers(0, 16, n, dtype=np.uint64),
+        wordspamrank=rng.integers(0, 16, n, dtype=np.uint64),
+        siterank=rng.integers(0, 16, n, dtype=np.uint64),
+        hashgroup=rng.integers(0, posdb.HASHGROUP_END, n, dtype=np.uint64),
+        langid=rng.integers(0, 64, n, dtype=np.uint64),
+        multiplier=rng.integers(0, 16, n, dtype=np.uint64),
+        synform=rng.integers(0, 4, n, dtype=np.uint64),
+        outlink=rng.integers(0, 2, n, dtype=np.uint64),
+        shardbytermid=rng.integers(0, 2, n, dtype=np.uint64),
+        delbit=rng.integers(0, 2, n, dtype=np.uint64),
+    )
+    keys = posdb.pack(**fields)
+    out = posdb.unpack(keys)
+    for name, want in fields.items():
+        np.testing.assert_array_equal(out[name], want, err_msg=name)
+
+
+def test_bit_positions_match_reference_layout():
+    """Spot-check documented bit positions (Posdb.h layout comment):
+    termid occupies n2[16:64], docid low 22 bits sit at n1[42:64],
+    delbit is n0 bit 0, alignment bit n0 bit 9 is always set."""
+    k = posdb.pack(termid=1, docid=1, delbit=1)
+    assert int(k["n2"]) == 1 << 16
+    assert int(k["n1"]) >> 42 == 1
+    assert int(k["n0"]) & 1 == 1
+    assert int(k["n0"]) & (1 << 9)  # alignment bit (Posdb.h setAlignmentBit)
+
+    k2 = posdb.pack(termid=0, docid=1 << 22)  # bit 22 of docid → n2 bit 0
+    assert int(k2["n2"]) == 1
+    assert int(k2["n1"]) >> 42 == 0
+
+
+def test_byte_image_roundtrip():
+    keys = posdb.pack(
+        termid=[5, 6], docid=[7, 8], wordpos=[9, 10], siterank=3
+    )
+    buf = posdb.to_bytes(keys)
+    assert len(buf) == 36
+    back = posdb.from_bytes(buf)
+    np.testing.assert_array_equal(back, keys)
+
+
+def test_sort_order_is_termid_docid_wordpos():
+    """Reference key compare is (n2,n1,n0) high-to-low, which orders by
+    termid, then docid, then wordpos — the order termlist intersection
+    relies on (Posdb.cpp docIdLoop)."""
+    keys = posdb.pack(
+        termid=[2, 1, 1, 1], docid=[0, 5, 2, 2], wordpos=[0, 0, 9, 3]
+    )
+    order = posdb.sort_order(keys)
+    f = posdb.unpack(keys[order])
+    np.testing.assert_array_equal(f["termid"], [1, 1, 1, 2])
+    np.testing.assert_array_equal(f["docid"], [2, 2, 5, 0])
+    np.testing.assert_array_equal(f["wordpos"], [3, 9, 0, 0])
+
+
+def test_start_end_key_bracket_termlist():
+    tid = 0xABCDEF
+    keys = posdb.pack(
+        termid=[tid, tid, tid], docid=[0, 1 << 37, (1 << 38) - 1],
+        wordpos=[0, 7, posdb.MAXWORDPOS],
+    )
+    lo, hi = posdb.start_key(tid), posdb.end_key(tid)
+    for k in keys:
+        assert (lo["n2"], lo["n1"], lo["n0"]) <= (k["n2"], k["n1"], k["n0"])
+        assert (k["n2"], k["n1"], k["n0"]) <= (hi["n2"], hi["n1"], hi["n0"])
+
+
+def test_shard_assignment_stable_and_balanced():
+    docids = np.arange(100_000, dtype=np.uint64)
+    s = posdb.shard_of_docid(docids, 8)
+    s2 = posdb.shard_of_docid(docids, 8)
+    np.testing.assert_array_equal(s, s2)
+    counts = np.bincount(s, minlength=8)
+    assert counts.min() > 0.8 * counts.max()  # balanced within 20%
+
+
+def test_shard_by_termid_bit_respected():
+    keys = posdb.pack(
+        termid=[10, 10], docid=[99, 99], shardbytermid=[0, 1]
+    )
+    shards = posdb.shard_of_keys(keys, 8)
+    assert shards[0] == posdb.shard_of_docid(np.uint64(99), 8)
+    assert shards[1] == posdb.shard_of_termid(np.uint64(10), 8)
+
+
+@pytest.mark.parametrize("field,maxval", [
+    ("wordpos", posdb.MAXWORDPOS),
+    ("densityrank", posdb.MAXDENSITYRANK),
+    ("siterank", posdb.MAXSITERANK),
+    ("langid", posdb.MAXLANGID),
+])
+def test_max_field_values_survive(field, maxval):
+    k = posdb.pack(termid=1, docid=1, **{field: maxval})
+    assert int(posdb.unpack(k)[field]) == maxval

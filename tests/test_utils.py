@@ -1,0 +1,99 @@
+"""Tests for utils: parm registry (Parms.cpp semantics), term hashing, URL
+normalization (Url.cpp semantics)."""
+
+import numpy as np
+import pytest
+
+from open_source_search_engine_tpu.utils import ghash, parms
+from open_source_search_engine_tpu.utils.url import normalize
+
+
+class TestGhash:
+    def test_hash64_stable_and_spread(self):
+        h1 = ghash.hash64("tiger")
+        assert h1 == ghash.hash64("tiger")
+        assert h1 != ghash.hash64("tigers")
+        assert 0 < h1 < 1 << 64
+
+    def test_term_id_case_insensitive_48bit(self):
+        assert ghash.term_id("Tiger") == ghash.term_id("tiger")
+        assert ghash.term_id("tiger") < 1 << 48
+
+    def test_prefix_separates_term_space(self):
+        assert ghash.term_id("foo.com") != ghash.term_id("foo.com", "site")
+
+    def test_bigram_order_sensitive(self):
+        assert ghash.bigram_id("new", "york") != ghash.bigram_id("york", "new")
+
+    def test_docid_38bit(self):
+        assert ghash.doc_id("http://a.com/") < 1 << 38
+
+    def test_vectorized_matches_scalar_finalizer(self):
+        arr = np.arange(1000, dtype=np.uint64)
+        out = ghash.hash64_array(arr)
+        assert len(np.unique(out)) == 1000
+
+
+class TestParms:
+    def test_defaults_and_set(self):
+        conf = parms.Conf()
+        assert conf.num_shards == 1
+        conf.set("num_shards", 8)
+        assert conf.num_shards == 8
+
+    def test_type_coercion(self):
+        conf = parms.Conf()
+        conf.set("http_port", "9000")
+        assert conf.http_port == 9000
+
+    def test_cgi_api(self):
+        coll = parms.CollectionConf("test")
+        coll.set_from_cgi("n", "25")
+        assert coll.docs_wanted == 25
+        coll.set_from_cgi("sc", "0")
+        assert coll.site_cluster is False
+
+    def test_unknown_parm_rejected(self):
+        with pytest.raises(KeyError):
+            parms.Conf().set("nope", 1)
+
+    def test_update_listener_fires(self):
+        conf = parms.Conf()
+        seen = []
+        conf.on_update(lambda k, v: seen.append((k, v)))
+        conf.set("max_mem", 123)
+        assert seen == [("max_mem", 123)]
+
+    def test_save_load_roundtrip(self, tmp_path):
+        conf = parms.Conf(num_shards=4)
+        p = tmp_path / "gb.conf.json"
+        conf.save(p)
+        conf2 = parms.Conf()
+        conf2.load(p)
+        assert conf2.num_shards == 4
+
+
+class TestUrl:
+    def test_normalize_basics(self):
+        u = normalize("HTTP://WWW.Example.COM:80/a/../b//c?x=1#frag")
+        assert u.scheme == "http"
+        assert u.host == "www.example.com"
+        assert u.port == 80
+        assert u.path == "/b/c"
+        assert u.query == "x=1"
+        assert u.full == "http://www.example.com/b/c?x=1"
+
+    def test_relative_resolution(self):
+        u = normalize("../c.html", base="http://a.com/x/y/z.html")
+        assert u.full == "http://a.com/x/c.html"
+
+    def test_domain_extraction(self):
+        assert normalize("http://www.a.foo.co.uk/").domain == "foo.co.uk"
+        assert normalize("http://blog.example.com/").domain == "example.com"
+
+    def test_idn_punycode(self):
+        u = normalize("http://bücher.de/")
+        assert u.host.startswith("xn--")
+
+    def test_site_is_host(self):
+        assert normalize("http://b.example.com/x").site == "b.example.com"
