@@ -1,0 +1,481 @@
+"""Rdb-lite — the host-side LSM record store, one engine for every database.
+
+Reference: the Rdb engine (SURVEY §2.2): ``Rdb.cpp`` (tree + per-collection
+bases, ``Rdb::addList`` ``Rdb.cpp:2006``, ``Rdb::dumpTree`` ``Rdb.cpp:1172``),
+``RdbTree``/``RdbBuckets`` (in-RAM memtable), ``RdbDump`` (tree→sorted file),
+``RdbMerge``/``RdbBase::attemptMerge`` (``RdbBase.cpp:1400``, background
+n-way file merge), ``RdbMap`` (per-file sparse page index), ``RdbList``
+(sorted run with +/- tombstone annihilation, ``RdbList.cpp`` ``merge_r``),
+and ``Msg5`` (read = merge memtable + all files, ``Msg5.h:50``).
+
+TPU-first redesign rather than a port:
+
+* Records are **columnar numpy arrays**, not byte-spliced lists — a sorted
+  run is a structured key array (+ optional payload blob with offsets), so
+  a termlist range-read is a zero-copy ``searchsorted`` slice that can be
+  handed straight to the device packer.
+* The memtable is a **sorted-buffer bucket** scheme like ``RdbBuckets``
+  (the reference's faster replacement for RdbTree): appends accumulate
+  unsorted, reads/sorts amortize via a dirty flag.
+* Merge is vectorized: concatenate → stable sort by (key, recency) →
+  newest-wins dedup → tombstone annihilation. The optional C++ core in
+  ``native/`` does the same streaming for runs that don't fit comfortably
+  in RAM.
+* Runs are directories of ``.npy`` files loaded with ``mmap_mode='r'`` —
+  the ``BigFile``+``RdbMap`` page-read path collapses into OS page-cache
+  + searchsorted.
+
+Keys are little-endian structured dtypes whose *reversed* field order is
+the comparison order (matching ``key144_t::operator<`` — most-significant
+word last in memory). Bit 0 of the least-significant field is the delbit:
+1 = positive record, 0 = tombstone (``types.h`` key convention).
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+
+from ..utils.log import get_logger
+
+log = get_logger("rdb")
+
+#: keys per RdbMap "page" — the reference maps one key per 16KB disk page
+#: (``RdbMap.h:64``); ours indexes every PAGE_KEYS keys of a run.
+PAGE_KEYS = 4096
+
+
+# ---------------------------------------------------------------------------
+# key-array helpers (generic over structured key dtypes)
+# ---------------------------------------------------------------------------
+
+def key_sort_order(keys: np.ndarray) -> np.ndarray:
+    """argsort in key-compare order: reversed declared fields, stable."""
+    fields = keys.dtype.names
+    return np.lexsort(tuple(keys[f] for f in fields))
+
+
+def keys_as_tuple(keys: np.ndarray) -> tuple[np.ndarray, ...]:
+    """(most significant … least significant) field views."""
+    return tuple(keys[f] for f in reversed(keys.dtype.names))
+
+
+def searchsorted_keys(sorted_keys: np.ndarray, probe: np.ndarray,
+                      side: str = "left") -> np.ndarray:
+    """Vectorized searchsorted over structured keys.
+
+    numpy can't searchsorted structured dtypes directly, so we merge-rank:
+    lexsort the concatenation of (sorted_keys, probes) with a tiebreak bit
+    that places probes before equal keys for ``side='left'`` and after for
+    ``side='right'``; each probe's insertion index is then its merged
+    position minus the number of probes ahead of it.
+    """
+    probe = np.atleast_1d(probe)
+    n, m = len(sorted_keys), len(probe)
+    if n == 0:
+        return np.zeros(m, dtype=np.int64)
+    all_keys = np.concatenate([np.asarray(sorted_keys), probe])
+    tie = np.empty(n + m, dtype=np.int8)
+    tie[:n], tie[n:] = (1, 0) if side == "left" else (0, 1)
+    order = np.lexsort((tie,) + tuple(all_keys[f] for f in all_keys.dtype.names))
+    merged_is_probe = order >= n
+    cum_probes = np.cumsum(merged_is_probe)
+    probe_positions = np.nonzero(merged_is_probe)[0]
+    out = np.empty(m, dtype=np.int64)
+    out[order[probe_positions] - n] = (
+        probe_positions - (cum_probes[probe_positions] - 1)
+    )
+    return out
+
+
+def strip_delbit(keys: np.ndarray) -> np.ndarray:
+    """Copy of keys with the delbit (bit 0 of least-significant field)
+    cleared — the 'same record' identity used by annihilation."""
+    out = keys.copy()
+    f0 = keys.dtype.names[0]
+    out[f0] = out[f0] & ~np.array(1, dtype=keys.dtype[f0])
+    return out
+
+
+def delbits(keys: np.ndarray) -> np.ndarray:
+    f0 = keys.dtype.names[0]
+    return (keys[f0] & np.array(1, dtype=keys.dtype[f0])).astype(bool)
+
+
+# ---------------------------------------------------------------------------
+# sorted record batches
+# ---------------------------------------------------------------------------
+
+@dataclass
+class RecordBatch:
+    """A sorted run of records: keys + optional var-length payloads.
+
+    The RdbList equivalent — but columnar: ``keys`` is a structured array,
+    ``data``/``offsets`` hold payloads (``data[offsets[i]:offsets[i+1]]`` is
+    record i's blob; both None for dataless dbs like posdb).
+    """
+
+    keys: np.ndarray
+    offsets: np.ndarray | None = None  # int64 [n+1]
+    data: np.ndarray | None = None     # uint8 blob
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+    @property
+    def has_data(self) -> bool:
+        return self.offsets is not None
+
+    def payload(self, i: int) -> bytes:
+        assert self.offsets is not None and self.data is not None
+        return bytes(self.data[self.offsets[i]:self.offsets[i + 1]])
+
+    def payloads(self) -> list[bytes]:
+        return [self.payload(i) for i in range(len(self))]
+
+    @staticmethod
+    def from_records(keys: np.ndarray, blobs: list[bytes] | None = None,
+                     presorted: bool = False) -> "RecordBatch":
+        if blobs is not None:
+            assert len(blobs) == len(keys)
+        if not presorted:
+            order = key_sort_order(keys)
+            keys = keys[order]
+            if blobs is not None:
+                blobs = [blobs[i] for i in order]
+        if blobs is None:
+            return RecordBatch(keys)
+        offsets = np.zeros(len(blobs) + 1, dtype=np.int64)
+        np.cumsum([len(b) for b in blobs], out=offsets[1:])
+        data = np.frombuffer(b"".join(blobs), dtype=np.uint8)
+        return RecordBatch(keys, offsets, data)
+
+    def slice(self, lo: int, hi: int) -> "RecordBatch":
+        if not self.has_data:
+            return RecordBatch(self.keys[lo:hi])
+        offs = self.offsets[lo:hi + 1]
+        return RecordBatch(
+            self.keys[lo:hi],
+            (offs - offs[0]).astype(np.int64),
+            self.data[offs[0]:offs[-1]],
+        )
+
+    def range(self, start_key: np.ndarray, end_key: np.ndarray) -> "RecordBatch":
+        """Records with start_key <= key <= end_key (RdbMap+RdbScan read)."""
+        lo = int(searchsorted_keys(self.keys, start_key.reshape(1), "left")[0])
+        hi = int(searchsorted_keys(self.keys, end_key.reshape(1), "right")[0])
+        return self.slice(lo, hi)
+
+
+def _dedup_newest(all_keys: np.ndarray, recency: np.ndarray,
+                  keep_tombstones: bool) -> np.ndarray:
+    """Indices of surviving records: for each key-sans-delbit group the
+    highest-recency record wins; surviving tombstones optionally dropped.
+    Result indices are in key-sorted order."""
+    ident = strip_delbit(all_keys)
+    # sort by (key-sans-delbit asc, recency desc) → first of each group is
+    # the newest version of that record
+    order = np.lexsort((-recency,) + tuple(ident[f] for f in ident.dtype.names))
+    ident_sorted = ident[order]
+    first_of_group = np.ones(len(order), dtype=bool)
+    if len(order) > 1:
+        same_as_prev = np.ones(len(order) - 1, dtype=bool)
+        for f in ident.dtype.names:
+            same_as_prev &= ident_sorted[f][1:] == ident_sorted[f][:-1]
+        first_of_group[1:] = ~same_as_prev
+    keep = order[first_of_group]
+    if not keep_tombstones:
+        keep = keep[delbits(all_keys[keep])]
+    return keep
+
+
+def merge_batches(batches: list[RecordBatch],
+                  keep_tombstones: bool = False) -> RecordBatch:
+    """N-way merge with newest-wins dedup and +/- annihilation.
+
+    Reference semantics (``RdbList.cpp`` ``indexMerge_r``/``merge_r``; Msg5
+    final merge): sources are ordered oldest→newest; for records whose keys
+    are equal ignoring the delbit, the newest survives; a surviving
+    tombstone (delbit 0) annihilates the record — it is dropped from the
+    output unless ``keep_tombstones`` (intermediate file merges keep the
+    tombstone so it can annihilate matches in files not part of the merge;
+    final reads drop them — ``RdbMerge`` vs ``Msg5`` behavior).
+    """
+    nonempty = [b for b in batches if len(b)]
+    if not nonempty:
+        if batches:  # preserve the caller's key dtype / data-ness
+            return batches[0]
+        return RecordBatch(np.empty(0, dtype=np.dtype([("n0", "<u2")])))
+    batches = nonempty
+    has_data = batches[0].has_data
+
+    all_keys = np.concatenate([b.keys for b in batches])
+    recency = np.concatenate(
+        [np.full(len(b), i, dtype=np.int64) for i, b in enumerate(batches)]
+    )
+    keep = _dedup_newest(all_keys, recency, keep_tombstones)
+    kept_keys = all_keys[keep]
+
+    if not has_data:
+        return RecordBatch(kept_keys)
+
+    # gather payloads for kept records
+    src_idx = np.empty(len(all_keys), dtype=np.int64)
+    rec_idx = np.empty(len(all_keys), dtype=np.int64)
+    pos0 = 0
+    for i, b in enumerate(batches):
+        src_idx[pos0:pos0 + len(b)] = i
+        rec_idx[pos0:pos0 + len(b)] = np.arange(len(b))
+        pos0 += len(b)
+    blobs = [batches[src_idx[j]].payload(int(rec_idx[j])) for j in keep]
+    return RecordBatch.from_records(kept_keys, blobs, presorted=True)
+
+
+# ---------------------------------------------------------------------------
+# on-disk immutable runs (BigFile + RdbMap + RdbDump equivalent)
+# ---------------------------------------------------------------------------
+
+class Run:
+    """One immutable sorted run on disk: a directory of mmap'd .npy files.
+
+    ``keys.npy`` (+ ``offsets.npy``/``data.npy`` for payload dbs) and
+    ``meta.json`` with the dtype and a sparse page index (first key per
+    PAGE_KEYS records — the ``RdbMap`` equivalent, used only as metadata
+    now that reads go through mmap+searchsorted).
+    """
+
+    def __init__(self, path: Path):
+        self.path = Path(path)
+        self.meta = json.loads((self.path / "meta.json").read_text())
+        self.keys = np.load(self.path / "keys.npy", mmap_mode="r")
+        self.offsets = None
+        self.data = None
+        if (self.path / "offsets.npy").exists():
+            self.offsets = np.load(self.path / "offsets.npy", mmap_mode="r")
+            self.data = np.load(self.path / "data.npy", mmap_mode="r")
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+    def batch(self) -> RecordBatch:
+        return RecordBatch(self.keys, self.offsets, self.data)
+
+    @staticmethod
+    def write(path: Path, batch: RecordBatch) -> "Run":
+        """RdbDump: persist a sorted batch as an immutable run."""
+        path = Path(path)
+        tmp = path.with_name(path.name + ".tmp")
+        if tmp.exists():
+            shutil.rmtree(tmp)
+        tmp.mkdir(parents=True)
+        np.save(tmp / "keys.npy", np.ascontiguousarray(batch.keys))
+        if batch.has_data:
+            np.save(tmp / "offsets.npy", batch.offsets)
+            np.save(tmp / "data.npy", batch.data)
+        page_firsts = [
+            [int(batch.keys[i][f]) for f in batch.keys.dtype.names]
+            for i in range(0, len(batch), PAGE_KEYS)
+        ]
+        (tmp / "meta.json").write_text(json.dumps({
+            "nrecs": len(batch),
+            "dtype": [[n, str(batch.keys.dtype[n])] for n in batch.keys.dtype.names],
+            "has_data": batch.has_data,
+            "page_keys": PAGE_KEYS,
+            "page_first_keys": page_firsts,
+        }))
+        tmp.rename(path)  # atomic publish
+        return Run(path)
+
+
+# ---------------------------------------------------------------------------
+# memtable (RdbBuckets equivalent)
+# ---------------------------------------------------------------------------
+
+class MemTable:
+    """Append-mostly in-RAM buffer of records; sorts lazily on read.
+
+    Reference ``RdbBuckets.h:87`` — flat sorted buckets replaced RdbTree
+    for posdb because appends dominate. Same idea: O(1) appends into a
+    pending list, one vectorized sort when a read or dump needs order.
+    """
+
+    def __init__(self, key_dtype: np.dtype, has_data: bool):
+        self.key_dtype = key_dtype
+        self.has_data = has_data
+        self._pending_keys: list[np.ndarray] = []
+        self._pending_blobs: list[bytes] = []
+        self._sorted: RecordBatch | None = None
+        self.nbytes = 0
+
+    def __len__(self) -> int:
+        n = sum(len(k) for k in self._pending_keys)
+        return n + (len(self._sorted) if self._sorted is not None else 0)
+
+    def add(self, keys: np.ndarray, blobs: list[bytes] | None = None) -> None:
+        keys = np.atleast_1d(keys).astype(self.key_dtype, copy=False)
+        if self.has_data:
+            assert blobs is not None and len(blobs) == len(keys)
+            self._pending_blobs.extend(blobs)
+            self.nbytes += sum(len(b) for b in blobs)
+        self._pending_keys.append(keys)
+        self.nbytes += keys.nbytes
+
+    def batch(self) -> RecordBatch:
+        """Sorted view of everything in RAM (newest-wins within memtable)."""
+        if self._pending_keys:
+            keys = np.concatenate(self._pending_keys)
+            blobs = self._pending_blobs if self.has_data else None
+            # newest-wins within the pending stream itself (the RdbTree
+            # replaces a node when an equal-sans-delbit key is re-added)
+            keep = _dedup_newest(keys, np.arange(len(keys), dtype=np.int64),
+                                 keep_tombstones=True)
+            fresh = RecordBatch.from_records(
+                keys[keep],
+                [blobs[int(i)] for i in keep] if blobs is not None else None,
+                presorted=True,
+            )
+            if self._sorted is not None and len(self._sorted):
+                # older sorted part first, fresh part newer; keep tombstones
+                # in RAM so they still annihilate records in on-disk runs
+                fresh = merge_batches([self._sorted, fresh],
+                                      keep_tombstones=True)
+            self._sorted = fresh
+            self._pending_keys = []
+            self._pending_blobs = []
+        if self._sorted is None:
+            empty = np.empty(0, dtype=self.key_dtype)
+            self._sorted = RecordBatch.from_records(
+                empty, [] if self.has_data else None)
+        return self._sorted
+
+    def clear(self) -> None:
+        self._pending_keys = []
+        self._pending_blobs = []
+        self._sorted = None
+        self.nbytes = 0
+
+
+# ---------------------------------------------------------------------------
+# the Rdb itself (per-collection base, like RdbBase)
+# ---------------------------------------------------------------------------
+
+class Rdb:
+    """One named database for one collection: memtable + immutable runs.
+
+    API mirrors the reference verbs: ``add`` (``Rdb::addList``), ``dump``
+    (``Rdb::dumpTree``), ``attempt_merge`` (``RdbBase::attemptMerge``),
+    ``get_list`` (``Msg5::getList`` — merged memtable+runs range read),
+    ``save``/``load`` (RdbTree ``-saved.dat`` checkpoint).
+    """
+
+    def __init__(self, name: str, directory: str | Path,
+                 key_dtype: np.dtype, has_data: bool = False,
+                 max_memtable_bytes: int = 64 << 20,
+                 max_runs: int = 8):
+        self.name = name
+        self.dir = Path(directory) / name
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.key_dtype = key_dtype
+        self.has_data = has_data
+        self.max_memtable_bytes = max_memtable_bytes
+        self.max_runs = max_runs
+        self.mem = MemTable(key_dtype, has_data)
+        self.runs: list[Run] = []
+        self._next_run_id = 0
+        self._load_existing_runs()
+
+    # --- writes ---
+
+    def add(self, keys: np.ndarray, blobs: list[bytes] | None = None) -> None:
+        """Add records; auto-dump when the memtable exceeds budget
+        (reference dumps at 90% full, ``Rdb.cpp:1172``)."""
+        self.mem.add(keys, blobs)
+        if self.mem.nbytes >= self.max_memtable_bytes:
+            self.dump()
+
+    def delete(self, keys: np.ndarray) -> None:
+        """Add tombstones for these keys (delbit cleared)."""
+        neg = strip_delbit(np.atleast_1d(keys).astype(self.key_dtype, copy=False))
+        self.mem.add(neg, [b""] * len(neg) if self.has_data else None)
+
+    def dump(self) -> Run | None:
+        """Memtable → new immutable run (RdbDump)."""
+        batch = self.mem.batch()
+        if not len(batch):
+            return None
+        run = Run.write(self.dir / f"run_{self._next_run_id:06d}", batch)
+        self._next_run_id += 1
+        self.runs.append(run)
+        self.mem.clear()
+        # the memtable checkpoint is now stale — drop it so a restart can't
+        # resurrect records that live in the freshly dumped run
+        saved = self.dir / "saved"
+        if saved.exists():
+            shutil.rmtree(saved)
+        log.debug("%s: dumped run %s (%d recs)", self.name, run.path.name, len(run))
+        if len(self.runs) > self.max_runs:
+            self.attempt_merge()
+        return run
+
+    def attempt_merge(self, force: bool = False) -> None:
+        """Merge runs down to bound file count (RdbBase::attemptMerge;
+        merge keeps tombstones unless it includes the oldest run, exactly
+        like the reference's 'don't drop negatives unless merging file 0')."""
+        if len(self.runs) <= 1 and not force:
+            return
+        includes_oldest = True  # we always merge the full set for now
+        merged = merge_batches(
+            [r.batch() for r in self.runs],
+            keep_tombstones=not includes_oldest,
+        )
+        old = self.runs
+        run = Run.write(self.dir / f"run_{self._next_run_id:06d}", merged)
+        self._next_run_id += 1
+        self.runs = [run]
+        for r in old:
+            shutil.rmtree(r.path)
+        log.debug("%s: merged %d runs -> %s (%d recs)",
+                  self.name, len(old), run.path.name, len(run))
+
+    # --- reads (Msg5 semantics) ---
+
+    def get_list(self, start_key: np.ndarray, end_key: np.ndarray) -> RecordBatch:
+        """Merged range read across runs + memtable, tombstones applied."""
+        sources = [r.batch().range(start_key, end_key) for r in self.runs]
+        sources.append(self.mem.batch().range(start_key, end_key))
+        return merge_batches(sources)
+
+    def get_all(self) -> RecordBatch:
+        sources = [r.batch() for r in self.runs]
+        sources.append(self.mem.batch())
+        return merge_batches(sources)
+
+    # --- checkpoint (Process::saveRdbTrees equivalent) ---
+
+    def save(self) -> None:
+        """Persist the memtable so a restart is lossless (``-saved.dat``)."""
+        batch = self.mem.batch()
+        saved = self.dir / "saved"
+        if saved.exists():
+            shutil.rmtree(saved)
+        if len(batch):
+            Run.write(saved, batch)
+
+    def load_saved(self) -> None:
+        saved = self.dir / "saved"
+        if saved.exists():
+            b = Run(saved).batch()
+            self.mem.add(b.keys.copy(),
+                         b.payloads() if self.has_data else None)
+
+    def _load_existing_runs(self) -> None:
+        for p in sorted(self.dir.glob("run_*")):
+            if p.is_dir() and not p.name.endswith(".tmp"):
+                self.runs.append(Run(p))
+                self._next_run_id = max(
+                    self._next_run_id, int(p.name.split("_")[1]) + 1)
+        self.load_saved()

@@ -17,7 +17,7 @@ changes to every host (serve.parm_sync).
 from __future__ import annotations
 
 import json
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from pathlib import Path
 from typing import Any, Callable
 
@@ -95,6 +95,14 @@ class _ParmObject:
         if name in values:
             return values[name]
         raise AttributeError(name)
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        # route parm names through set() so plain assignment can't shadow
+        # the registry (conf.num_shards = 8 must behave like set())
+        if name.startswith("_"):
+            object.__setattr__(self, name, value)
+        else:
+            self.set(name, value)
 
     def set(self, name: str, value: Any, *, _from_sync: bool = False) -> None:
         parm = _BY_SCOPE[self._scope].get(name)

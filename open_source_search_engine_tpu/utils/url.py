@@ -36,9 +36,10 @@ class Url:
 
     @property
     def full(self) -> str:
-        netloc = self.host
-        if self.port != DEFAULT_PORTS.get(self.scheme):
-            netloc = f"{self.host}:{self.port}"
+        host = f"[{self.host}]" if ":" in self.host else self.host
+        netloc = host
+        if self.port and self.port != DEFAULT_PORTS.get(self.scheme):
+            netloc = f"{host}:{self.port}"
         return urlunsplit((self.scheme, netloc, self.path, self.query, ""))
 
     @property
@@ -79,7 +80,10 @@ def normalize(raw: str, base: str | None = None) -> Url:
         host = host.encode("idna").decode("ascii") if host else host
     except UnicodeError:
         pass
-    port = parts.port or DEFAULT_PORTS.get(scheme, 0)
+    try:
+        port = parts.port or DEFAULT_PORTS.get(scheme, 0)
+    except ValueError:  # non-numeric or out-of-range port in a crawled href
+        port = DEFAULT_PORTS.get(scheme, 0)
     path = parts.path or "/"
     # collapse duplicate slashes, resolve . / .. segments
     segs: list[str] = []

@@ -1,0 +1,205 @@
+"""Rdb-lite tests — modeled on the reference's component test binaries
+``rdbtest``/``mergetest``/``treetest``/``bucketstest`` (SURVEY §4.3):
+add/dump/merge/read cycles, tombstone annihilation, crash-restart
+persistence."""
+
+import numpy as np
+import pytest
+
+from open_source_search_engine_tpu.index import posdb, rdblite
+from open_source_search_engine_tpu.index.rdblite import (
+    MemTable, RecordBatch, Rdb, Run, merge_batches, searchsorted_keys,
+)
+
+
+def make_keys(termids, docids, wordpos=0, delbit=1):
+    return posdb.pack(termid=termids, docid=docids, wordpos=wordpos,
+                      delbit=delbit)
+
+
+class TestSearchsorted:
+    def test_matches_flat_searchsorted_on_random_keys(self):
+        rng = np.random.default_rng(1)
+        n = 5000
+        keys = posdb.pack(
+            termid=rng.integers(0, 50, n), docid=rng.integers(0, 1000, n),
+            wordpos=rng.integers(0, 100, n),
+        )
+        keys = keys[rdblite.key_sort_order(keys)]
+        # flat integer image for ground truth: (n2, n1, n0) as python tuples
+        flat = [(int(k["n2"]), int(k["n1"]), int(k["n0"])) for k in keys]
+        probes = keys[rng.integers(0, n, 64)]
+        for side in ("left", "right"):
+            got = searchsorted_keys(keys, probes, side)
+            import bisect
+            for g, p in zip(got, probes):
+                t = (int(p["n2"]), int(p["n1"]), int(p["n0"]))
+                want = (bisect.bisect_left if side == "left"
+                        else bisect.bisect_right)(flat, t)
+                assert g == want
+
+    def test_empty_sorted_array(self):
+        keys = make_keys([1], [1])
+        out = searchsorted_keys(keys[:0], keys)
+        assert out.tolist() == [0]
+
+
+class TestRecordBatch:
+    def test_from_records_sorts(self):
+        keys = make_keys([2, 1, 1], [5, 9, 3])
+        b = RecordBatch.from_records(keys)
+        f = posdb.unpack(b.keys)
+        assert f["termid"].tolist() == [1, 1, 2]
+        assert f["docid"].tolist() == [3, 9, 5]
+
+    def test_payloads_follow_sort(self):
+        keys = make_keys([2, 1], [1, 1])
+        b = RecordBatch.from_records(keys, [b"two", b"one"])
+        assert b.payloads() == [b"one", b"two"]
+
+    def test_range_read(self):
+        keys = make_keys([1, 2, 2, 3], [1, 1, 2, 1])
+        b = RecordBatch.from_records(keys)
+        sub = b.range(posdb.start_key(2), posdb.end_key(2))
+        f = posdb.unpack(sub.keys)
+        assert f["termid"].tolist() == [2, 2]
+        assert f["docid"].tolist() == [1, 2]
+
+
+class TestMerge:
+    def test_annihilation_negative_kills_positive(self):
+        """A tombstone in a newer source annihilates the positive record
+        (reference RdbList merge_r semantics)."""
+        old = RecordBatch.from_records(make_keys([1, 1], [10, 20]))
+        neg = RecordBatch.from_records(make_keys([1], [10], delbit=0))
+        out = merge_batches([old, neg])
+        f = posdb.unpack(out.keys)
+        assert f["docid"].tolist() == [20]
+
+    def test_positive_readd_after_delete_survives(self):
+        """delete then re-add: newest wins, record comes back."""
+        v1 = RecordBatch.from_records(make_keys([1], [10]))
+        neg = RecordBatch.from_records(make_keys([1], [10], delbit=0))
+        v2 = RecordBatch.from_records(make_keys([1], [10]))
+        out = merge_batches([v1, neg, v2])
+        assert len(out) == 1
+        assert posdb.unpack(out.keys)["delbit"].tolist() == [1]
+
+    def test_keep_tombstones_intermediate_merge(self):
+        v1 = RecordBatch.from_records(make_keys([1], [10]))
+        neg = RecordBatch.from_records(make_keys([1], [10], delbit=0))
+        out = merge_batches([v1, neg], keep_tombstones=True)
+        assert len(out) == 1
+        assert posdb.unpack(out.keys)["delbit"].tolist() == [0]
+
+    def test_payload_newest_wins(self):
+        k = make_keys([1], [10])
+        out = merge_batches([
+            RecordBatch.from_records(k.copy(), [b"old"]),
+            RecordBatch.from_records(k.copy(), [b"new"]),
+        ])
+        assert out.payloads() == [b"new"]
+
+    def test_merge_is_sorted_and_distinct_positions_survive(self):
+        """Same (termid,docid) at different wordpos are distinct records."""
+        a = RecordBatch.from_records(make_keys([1, 1], [10, 10], [3, 7]))
+        b = RecordBatch.from_records(make_keys([1], [10], [5]))
+        out = merge_batches([a, b])
+        f = posdb.unpack(out.keys)
+        assert f["wordpos"].tolist() == [3, 5, 7]
+
+    def test_all_empty_preserves_dtype(self):
+        empty = RecordBatch.from_records(make_keys([], []))
+        out = merge_batches([empty])
+        assert out.keys.dtype == posdb.KEY_DTYPE
+
+
+class TestMemTable:
+    def test_append_then_sorted_read(self):
+        mt = MemTable(posdb.KEY_DTYPE, has_data=False)
+        mt.add(make_keys([3], [1]))
+        mt.add(make_keys([1, 2], [1, 1]))
+        f = posdb.unpack(mt.batch().keys)
+        assert f["termid"].tolist() == [1, 2, 3]
+
+    def test_tombstone_retained_in_ram(self):
+        mt = MemTable(posdb.KEY_DTYPE, has_data=False)
+        mt.add(make_keys([1], [5]))
+        mt.add(make_keys([1], [5], delbit=0))
+        b = mt.batch()
+        assert len(b) == 1
+        assert posdb.unpack(b.keys)["delbit"].tolist() == [0]
+
+
+class TestRdb:
+    def test_add_dump_read_cycle(self, tmp_path):
+        db = Rdb("posdb", tmp_path, posdb.KEY_DTYPE)
+        db.add(make_keys([1, 2], [10, 20]))
+        db.dump()
+        db.add(make_keys([1], [11]))
+        lst = db.get_list(posdb.start_key(1), posdb.end_key(1))
+        f = posdb.unpack(lst.keys)
+        assert sorted(f["docid"].tolist()) == [10, 11]
+
+    def test_delete_across_dump_boundary(self, tmp_path):
+        db = Rdb("posdb", tmp_path, posdb.KEY_DTYPE)
+        db.add(make_keys([7], [100]))
+        db.dump()
+        db.delete(make_keys([7], [100]))
+        lst = db.get_list(posdb.start_key(7), posdb.end_key(7))
+        assert len(lst) == 0
+
+    def test_merge_bounds_run_count(self, tmp_path):
+        db = Rdb("posdb", tmp_path, posdb.KEY_DTYPE, max_runs=3)
+        for i in range(5):
+            db.add(make_keys([i], [i]))
+            db.dump()
+        assert len(db.runs) <= 3 + 1
+        all_recs = db.get_all()
+        assert len(all_recs) == 5
+
+    def test_payload_db(self, tmp_path):
+        db = Rdb("titledb", tmp_path, posdb.KEY_DTYPE, has_data=True)
+        db.add(make_keys([1], [10]), [b"hello world"])
+        db.dump()
+        db.add(make_keys([1], [11]), [b"second"])
+        lst = db.get_list(posdb.start_key(1), posdb.end_key(1))
+        assert lst.payloads() == [b"hello world", b"second"]
+
+    def test_restart_recovers_runs_and_memtable(self, tmp_path):
+        """Crash-restart: dumped runs + saved memtable reload losslessly
+        (reference -saved.dat semantics, Process.cpp:1444)."""
+        db = Rdb("posdb", tmp_path, posdb.KEY_DTYPE)
+        db.add(make_keys([1], [10]))
+        db.dump()
+        db.add(make_keys([1], [11]))  # stays in memtable
+        db.save()
+        db2 = Rdb("posdb", tmp_path, posdb.KEY_DTYPE)
+        lst = db2.get_list(posdb.start_key(1), posdb.end_key(1))
+        f = posdb.unpack(lst.keys)
+        assert sorted(f["docid"].tolist()) == [10, 11]
+
+    def test_auto_dump_on_budget(self, tmp_path):
+        db = Rdb("posdb", tmp_path, posdb.KEY_DTYPE,
+                 max_memtable_bytes=1000)
+        db.add(make_keys(np.arange(200), np.arange(200)))
+        assert len(db.runs) >= 1
+
+    def test_large_roundtrip_with_merge(self, tmp_path):
+        rng = np.random.default_rng(2)
+        db = Rdb("posdb", tmp_path, posdb.KEY_DTYPE)
+        seen = set()
+        for batch_i in range(4):
+            tids = rng.integers(0, 20, 2000)
+            dids = rng.integers(0, 500, 2000)
+            wps = rng.integers(0, 50, 2000)
+            db.add(make_keys(tids, dids, wps))
+            seen.update(zip(tids.tolist(), dids.tolist(), wps.tolist()))
+            db.dump()
+        db.attempt_merge(force=True)
+        assert len(db.runs) == 1
+        out = db.get_all()
+        f = posdb.unpack(out.keys)
+        got = set(zip(f["termid"].tolist(), f["docid"].tolist(),
+                      f["wordpos"].tolist()))
+        assert got == seen

@@ -97,3 +97,27 @@ class TestUrl:
 
     def test_site_is_host(self):
         assert normalize("http://b.example.com/x").site == "b.example.com"
+
+    def test_malformed_port_does_not_crash(self):
+        assert normalize("http://a.com:abc/").full == "http://a.com/"
+        assert normalize("http://a.com:99999/").full == "http://a.com/"
+
+    def test_ipv6_brackets_roundtrip(self):
+        assert normalize("http://[::1]:8080/x").full == "http://[::1]:8080/x"
+
+    def test_unknown_scheme_no_fabricated_port(self):
+        assert normalize("ftp://a.com/x").full == "ftp://a.com/x"
+
+
+class TestParmAttrAssign:
+    def test_plain_assignment_routes_through_registry(self):
+        conf = parms.Conf()
+        conf.num_shards = 8
+        conf.set("num_shards", 4)
+        assert conf.num_shards == 4
+        assert conf.to_dict()["num_shards"] == 4
+
+    def test_unknown_attr_assignment_rejected(self):
+        conf = parms.Conf()
+        with pytest.raises(KeyError):
+            conf.nonexistent_parm = 1
