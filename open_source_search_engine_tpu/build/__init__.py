@@ -1,0 +1,9 @@
+"""Build layer: document pipeline (crawl → parse → index).
+
+Reference layer L4 (SURVEY §2.6): ``XmlDoc.cpp`` (56k LoC lazy DAG),
+``Xml``/``Words``/``Phrases``/``Pos``/``Sections`` (tokenize + positions),
+``Spider.cpp`` (crawl scheduler), ``Msg13`` (fetcher), ``PageInject``
+(direct injection). Here the pipeline is a straight-line function over
+columnar arrays instead of a 200-stage callback DAG: tokenize → rank
+vectors → vectorized posdb key pack → one batched Rdb add per database.
+"""

@@ -1,0 +1,203 @@
+"""HTML tokenizer → positioned, hashgroup-tagged word stream.
+
+Reference: ``Xml.cpp``/``XmlNode.cpp`` (tag/text node tokenizer),
+``Words.cpp`` (word segmentation), ``Pos.cpp`` (word position counting:
+~+1 per alnum word, +2 at sentence punctuation), ``Sections.cpp`` (section
+tree — we keep a flat sentence model), and the hashgroup assignment done in
+``XmlDoc::hashAll`` (``XmlDoc.cpp:28957``): body/title/heading/list/menu/
+meta/url tokens are hashed into distinct HASHGROUP_* spaces (``Posdb.h:74``).
+
+Output is columnar: parallel lists of (word, wordpos, hashgroup,
+sentence_id) ready for vectorized rank computation and key packing.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from html.parser import HTMLParser
+
+from ..index.posdb import (
+    HASHGROUP_BODY, HASHGROUP_HEADING, HASHGROUP_INLIST, HASHGROUP_INMENU,
+    HASHGROUP_INMETATAG, HASHGROUP_INTAG, HASHGROUP_INURL, HASHGROUP_TITLE,
+    MAXWORDPOS,
+)
+
+_WORD_RE = re.compile(r"\w+", re.UNICODE)
+_SENT_SPLIT_RE = re.compile(r"[.!?;:]+")
+
+_HEADING_TAGS = {"h1", "h2", "h3", "h4", "h5", "h6"}
+_SKIP_TAGS = {"script", "style", "noscript", "template", "svg"}
+_LIST_TAGS = {"li", "dd", "dt"}
+_MENU_TAGS = {"nav", "menu"}
+_BLOCK_TAGS = {
+    "p", "div", "br", "tr", "td", "table", "ul", "ol", "section", "article",
+    "header", "footer", "blockquote", "pre", "h1", "h2", "h3", "h4", "h5",
+    "h6", "li", "title",
+}
+
+#: extra position gap at sentence punctuation (Pos.cpp adds 2)
+SENT_GAP = 2
+#: extra position gap at block-tag boundaries (section breaks)
+BLOCK_GAP = 4
+
+
+@dataclass
+class Token:
+    word: str
+    wordpos: int
+    hashgroup: int
+    sentence_id: int
+
+
+@dataclass
+class TokenizedDoc:
+    """The parse product consumed by the indexer (docproc)."""
+
+    tokens: list[Token] = field(default_factory=list)
+    title: str = ""
+    meta_description: str = ""
+    links: list[tuple[str, str]] = field(default_factory=list)  # (href, anchor text)
+    text: str = ""  # visible text, for titlerec/snippets
+
+
+class _HtmlTok(HTMLParser):
+    def __init__(self):
+        super().__init__(convert_charrefs=True)
+        self.doc = TokenizedDoc()
+        self._pos = 0
+        self._sent = 0
+        self._skip_depth = 0
+        self._title_depth = 0
+        self._heading_depth = 0
+        self._list_depth = 0
+        self._menu_depth = 0
+        self._anchor_href: str | None = None
+        self._anchor_words: list[str] = []
+        self._text_parts: list[str] = []
+
+    # -- tag events --
+
+    def handle_starttag(self, tag, attrs):
+        if tag in _SKIP_TAGS:
+            self._skip_depth += 1
+            return
+        if self._skip_depth:  # no bookkeeping inside <noscript>/<svg>/...
+            return
+        if tag == "title":
+            self._title_depth += 1
+        elif tag in _HEADING_TAGS:
+            self._heading_depth += 1
+        elif tag in _LIST_TAGS:
+            self._list_depth += 1
+        elif tag in _MENU_TAGS:
+            self._menu_depth += 1
+        elif tag == "a":
+            d = dict(attrs)
+            self._anchor_href = d.get("href")
+            self._anchor_words = []
+        elif tag == "meta":
+            d = dict(attrs)
+            name = (d.get("name") or "").lower()
+            content = d.get("content") or ""
+            if name in ("description", "keywords") and content:
+                if name == "description":
+                    self.doc.meta_description = content
+                # each meta tag is its own sentence so words from different
+                # tags never look adjacent (no cross-tag bigrams)
+                self._sent += 1
+                self._emit_words(content, HASHGROUP_INMETATAG)
+                self._sent += 1
+        if tag in _BLOCK_TAGS:
+            self._pos += BLOCK_GAP
+            self._sent += 1
+
+    def handle_endtag(self, tag):
+        if tag in _SKIP_TAGS:
+            self._skip_depth = max(0, self._skip_depth - 1)
+            return
+        if self._skip_depth:
+            return
+        if tag == "title":
+            self._title_depth = max(0, self._title_depth - 1)
+        elif tag in _HEADING_TAGS:
+            self._heading_depth = max(0, self._heading_depth - 1)
+        elif tag in _LIST_TAGS:
+            self._list_depth = max(0, self._list_depth - 1)
+        elif tag in _MENU_TAGS:
+            self._menu_depth = max(0, self._menu_depth - 1)
+        elif tag == "a" and self._anchor_href is not None:
+            self.doc.links.append(
+                (self._anchor_href, " ".join(self._anchor_words))
+            )
+            self._anchor_href = None
+            self._anchor_words = []
+        if tag in _BLOCK_TAGS:
+            self._pos += BLOCK_GAP
+            self._sent += 1
+
+    # -- text events --
+
+    def handle_data(self, data):
+        if self._skip_depth:
+            return
+        if self._title_depth:
+            self.doc.title += data
+            self._emit_words(data, HASHGROUP_TITLE)
+            return
+        hg = HASHGROUP_BODY
+        if self._heading_depth:
+            hg = HASHGROUP_HEADING
+        elif self._list_depth:
+            hg = HASHGROUP_INLIST
+        elif self._menu_depth:
+            hg = HASHGROUP_INMENU
+        if self._anchor_href is not None:
+            self._anchor_words.extend(
+                w.lower() for w in _WORD_RE.findall(data)
+            )
+        self._text_parts.append(data)
+        self._emit_words(data, hg)
+
+    # -- word emission with Pos.cpp-style position advance --
+
+    def _emit_words(self, data: str, hashgroup: int) -> None:
+        for chunk in _SENT_SPLIT_RE.split(data):
+            for m in _WORD_RE.finditer(chunk):
+                self.doc.tokens.append(Token(
+                    m.group(0).lower(),
+                    min(self._pos, MAXWORDPOS),
+                    hashgroup,
+                    self._sent,
+                ))
+                self._pos += 1
+            self._pos += SENT_GAP
+            self._sent += 1
+        # undo the trailing split's gap when data had no sentence break
+        self._pos -= SENT_GAP
+        self._sent -= 1
+
+
+def tokenize_html(html: str, url: str | None = None) -> TokenizedDoc:
+    """Tokenize an HTML document; URL path words are added to
+    HASHGROUP_INURL (reference hashes the url into its own group,
+    ``XmlDoc.cpp`` ``hashUrl``)."""
+    p = _HtmlTok()
+    p.feed(html)
+    p.close()
+    doc = p.doc
+    doc.text = re.sub(r"\s+", " ", " ".join(p._text_parts)).strip()
+    if url:
+        for m in _WORD_RE.finditer(url.lower()):
+            doc.tokens.append(Token(m.group(0), 0, HASHGROUP_INURL, 0))
+    return doc
+
+
+def tokenize_text(text: str, hashgroup: int = HASHGROUP_BODY) -> TokenizedDoc:
+    """Tokenize plain text (injection of non-HTML content; reference doc
+    converters produce plain text fed through the same path)."""
+    p = _HtmlTok()
+    p._emit_words(text, hashgroup)
+    doc = p.doc
+    doc.text = re.sub(r"\s+", " ", text).strip()
+    return doc

@@ -1,0 +1,49 @@
+"""Clusterdb — docid → (site hash, language, family filter) for query-time
+site clustering and adult filtering.
+
+Reference: ``Clusterdb.h:42`` — a dataless 16-byte key holding sitehash26,
+familyFilter bit and langId, docid-keyed, looked up by Msg51 during result
+clustering (max 2 results per site). Ours: a dataless 12-byte key, docid in
+n1 (sort by docid), packed meta in n0. At query time the whole table is
+materialized into device-resident columnar arrays (ops.pack) so clustering
+is a vectorized pass instead of per-docid cache lookups.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .titledb import KEY_DTYPE  # same 12-byte docid-major key shape
+
+SITEHASH_BITS = 24
+
+
+def pack_key(docid, sitehash, langid=0, adult=0, delbit=1) -> np.ndarray:
+    """n1 = docid; n0 = sitehash24<<8 | langid6<<2 | adult<<1 | delbit."""
+    docid = np.asarray(docid, dtype=np.uint64)
+    sitehash = np.asarray(sitehash, dtype=np.uint64)
+    langid_a = np.asarray(langid, dtype=np.uint64)
+    adult_a = np.asarray(adult, dtype=np.uint64)
+    delbit_a = np.asarray(delbit, dtype=np.uint64)
+    docid, sitehash, langid_a, adult_a, delbit_a = np.broadcast_arrays(
+        docid, sitehash, langid_a, adult_a, delbit_a)
+    out = np.empty(docid.shape, dtype=KEY_DTYPE)
+    out["n1"] = docid
+    out["n0"] = (
+        ((sitehash & np.uint64((1 << SITEHASH_BITS) - 1)) << np.uint64(8))
+        | ((langid_a & np.uint64(0x3F)) << np.uint64(2))
+        | ((adult_a & np.uint64(1)) << np.uint64(1))
+        | (delbit_a & np.uint64(1))
+    ).astype(np.uint32)
+    return out
+
+
+def unpack_key(keys: np.ndarray) -> dict[str, np.ndarray]:
+    n0 = keys["n0"].astype(np.uint64)
+    return {
+        "docid": keys["n1"],
+        "sitehash": n0 >> np.uint64(8),
+        "langid": (n0 >> np.uint64(2)) & np.uint64(0x3F),
+        "adult": (n0 >> np.uint64(1)) & np.uint64(1),
+        "delbit": n0 & np.uint64(1),
+    }

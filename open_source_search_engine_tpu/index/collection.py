@@ -1,0 +1,86 @@
+"""Collection — one searchable corpus: the set of Rdbs plus per-collection
+config.
+
+Reference: ``Collectiondb.cpp/h`` (``Collectiondb.h:39`` — multi-tenant
+CollectionRecs, each owning per-collection RdbBases for every database) and
+the per-Rdb init calls in ``main.cpp:3395-3500``. A Collection here owns
+posdb (positional index, dataless 18B keys), titledb (doc records),
+clusterdb (site/lang meta) — linkdb/spiderdb/tagdb attach in the crawler
+milestone — plus doc/term counters used for ranking (termFreqWeight needs
+numDocsInColl, reference ``Posdb.cpp:1225``).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from ..utils.parms import CollectionConf
+from . import clusterdb, posdb, rdblite, titledb
+
+
+class Collection:
+    def __init__(self, name: str, base_dir: str | Path,
+                 conf: CollectionConf | None = None):
+        self.name = name
+        self.dir = Path(base_dir) / "coll" / name
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.conf = conf or CollectionConf(name)
+        self.posdb = rdblite.Rdb("posdb", self.dir, posdb.KEY_DTYPE)
+        self.titledb = rdblite.Rdb("titledb", self.dir, titledb.KEY_DTYPE,
+                                   has_data=True)
+        self.clusterdb = rdblite.Rdb("clusterdb", self.dir,
+                                     clusterdb.KEY_DTYPE)
+        self._stats_path = self.dir / "collstats.json"
+        self.num_docs = 0
+        self._load_stats()
+
+    # --- stats used by ranking ---
+
+    def _load_stats(self) -> None:
+        if self._stats_path.exists():
+            self.num_docs = json.loads(self._stats_path.read_text())["num_docs"]
+
+    def _save_stats(self) -> None:
+        self._stats_path.write_text(json.dumps({"num_docs": self.num_docs}))
+
+    def doc_added(self, n: int = 1) -> None:
+        self.num_docs += n
+
+    def doc_removed(self, n: int = 1) -> None:
+        self.num_docs = max(0, self.num_docs - n)
+
+    # --- lifecycle (Process::saveRdbTrees equivalent) ---
+
+    def save(self) -> None:
+        for db in (self.posdb, self.titledb, self.clusterdb):
+            db.save()
+        self._save_stats()
+
+    def dump_all(self) -> None:
+        for db in (self.posdb, self.titledb, self.clusterdb):
+            db.dump()
+        self._save_stats()
+
+
+class CollectionDb:
+    """Registry of collections (reference ``g_collectiondb``)."""
+
+    def __init__(self, base_dir: str | Path):
+        self.base_dir = Path(base_dir)
+        self.colls: dict[str, Collection] = {}
+
+    def get(self, name: str = "main", create: bool = True) -> Collection:
+        if name not in self.colls:
+            if not create and not (self.base_dir / "coll" / name).exists():
+                raise KeyError(f"no such collection: {name}")
+            self.colls[name] = Collection(name, self.base_dir)
+        return self.colls[name]
+
+    def names(self) -> list[str]:
+        disk = {p.name for p in (self.base_dir / "coll").glob("*") if p.is_dir()}
+        return sorted(disk | set(self.colls))
+
+    def save_all(self) -> None:
+        for c in self.colls.values():
+            c.save()

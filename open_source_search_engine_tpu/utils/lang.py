@@ -1,0 +1,69 @@
+"""Language identification (reference: ``Language.cpp``/``LanguageIdentifier.cpp``
+~8k LoC of charset+dictionary scoring; ours is a compact stopword-profile
+scorer — same contract: text → langId used in posdb keys and same-language
+query boost (``Posdb.cpp`` SAMELANGMULT))."""
+
+from __future__ import annotations
+
+# langIds — reference Lang.h enumerates ~60; we carry the common set and
+# the same "0 = unknown" convention the scorer relies on.
+LANG_UNKNOWN = 0
+LANG_ENGLISH = 1
+LANG_FRENCH = 2
+LANG_SPANISH = 3
+LANG_GERMAN = 4
+LANG_ITALIAN = 5
+LANG_PORTUGUESE = 6
+LANG_DUTCH = 7
+LANG_RUSSIAN = 8
+
+LANG_NAMES = {
+    LANG_UNKNOWN: "xx", LANG_ENGLISH: "en", LANG_FRENCH: "fr",
+    LANG_SPANISH: "es", LANG_GERMAN: "de", LANG_ITALIAN: "it",
+    LANG_PORTUGUESE: "pt", LANG_DUTCH: "nl", LANG_RUSSIAN: "ru",
+}
+LANG_IDS = {v: k for k, v in LANG_NAMES.items()}
+
+_PROFILES: dict[int, frozenset[str]] = {
+    LANG_ENGLISH: frozenset(
+        "the a an of and to in is was for that with are his this they have "
+        "from not had her she you were which their been has will would "
+        "there on it at by but be or as we".split()),
+    LANG_FRENCH: frozenset(
+        "le la les de des du et en un une est pour que qui dans sur pas au "
+        "avec son ses par plus ne se ce cette mais ou donc".split()),
+    LANG_SPANISH: frozenset(
+        "el la los las de del y en un una es por que con para su como más "
+        "pero sus le ya o este sí porque esta entre cuando".split()),
+    LANG_GERMAN: frozenset(
+        "der die das und in den von zu mit sich des auf für ist im dem nicht "
+        "ein eine als auch es an werden aus er hat dass sie nach".split()),
+    LANG_ITALIAN: frozenset(
+        "il la le di del e in un una è per che con non si da dei al come "
+        "più ma gli alla sono questo anche della nel".split()),
+    LANG_PORTUGUESE: frozenset(
+        "o a os as de do da e em um uma é por que com para seu como mais "
+        "mas foi ao não se na dos das pelo".split()),
+    LANG_DUTCH: frozenset(
+        "de het een en van in is dat op te zijn met voor niet aan er ook als "
+        "bij maar om uit door over ze hij".split()),
+    LANG_RUSSIAN: frozenset(
+        "и в не на я что он с как это по но они мы все она так его за был "
+        "от то же бы у вы из".split()),
+}
+
+
+def detect_language(words: list[str], min_hits: int = 2) -> int:
+    """Best stopword-profile match over the token stream; LANG_UNKNOWN when
+    nothing clears the bar (the reference also falls back to charset and
+    TLD hints — callers can overlay those)."""
+    if not words:
+        return LANG_UNKNOWN
+    sample = set(words[:2000])
+    best, best_hits = LANG_UNKNOWN, 0
+    for lang, profile in _PROFILES.items():
+        # distinct stopwords hit, so one frequent word can't dominate
+        hits = len(sample & profile)
+        if hits > best_hits:
+            best, best_hits = lang, hits
+    return best if best_hits >= min_hits else LANG_UNKNOWN

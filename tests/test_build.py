@@ -1,0 +1,161 @@
+"""Document pipeline tests — modeled on the reference's parser-consistency
+harness (``Test.cpp``, ``gb parsetest``) and the qainject scenarios
+(``qa.cpp:659``): tokenizer hashgroup assignment, rank semantics,
+inject → read back, delete → gone, reindex consistency."""
+
+import numpy as np
+
+from open_source_search_engine_tpu.build import docproc
+from open_source_search_engine_tpu.build.tokenizer import tokenize_html
+from open_source_search_engine_tpu.index import posdb, titledb
+from open_source_search_engine_tpu.index.collection import Collection
+from open_source_search_engine_tpu.utils import ghash
+from open_source_search_engine_tpu.utils.lang import LANG_ENGLISH, LANG_GERMAN, detect_language
+
+HTML = """
+<html><head><title>Tiger Habitat</title>
+<meta name="description" content="All about tigers">
+<script>var x = "ignoreme";</script>
+<style>.c { color: red }</style>
+</head><body>
+<h1>The Siberian Tiger</h1>
+<p>The tiger is the largest living cat species. Tigers are apex predators.</p>
+<ul><li>Bengal tiger</li><li>Siberian tiger</li></ul>
+<nav><a href="/about">About tigers</a></nav>
+<p>Visit <a href="http://cats.example.com/lions">our lion page</a> too.</p>
+</body></html>
+"""
+
+
+class TestTokenizer:
+    def test_hashgroups_assigned(self):
+        doc = tokenize_html(HTML, "http://example.com/tigers")
+        by_hg = {}
+        for t in doc.tokens:
+            by_hg.setdefault(t.hashgroup, []).append(t.word)
+        assert "habitat" in by_hg[posdb.HASHGROUP_TITLE]
+        assert "siberian" in by_hg[posdb.HASHGROUP_HEADING]
+        assert "largest" in by_hg[posdb.HASHGROUP_BODY]
+        assert "bengal" in by_hg[posdb.HASHGROUP_INLIST]
+        assert "about" in by_hg[posdb.HASHGROUP_INMENU]
+        assert "description" not in str(by_hg.get(posdb.HASHGROUP_BODY, []))
+        assert "tigers" in by_hg[posdb.HASHGROUP_INMETATAG]
+        assert "example" in by_hg[posdb.HASHGROUP_INURL]
+
+    def test_script_and_style_skipped(self):
+        doc = tokenize_html(HTML)
+        words = {t.word for t in doc.tokens}
+        assert "ignoreme" not in words
+        assert "color" not in words
+
+    def test_links_with_anchor_text(self):
+        doc = tokenize_html(HTML)
+        hrefs = dict(doc.links)
+        assert hrefs["http://cats.example.com/lions"] == "our lion page"
+
+    def test_positions_increase(self):
+        doc = tokenize_html(HTML)
+        body = [t for t in doc.tokens if t.hashgroup == posdb.HASHGROUP_BODY]
+        pos = [t.wordpos for t in body]
+        assert pos == sorted(pos)
+        assert len(set(pos)) == len(pos)
+
+    def test_title_extracted(self):
+        assert tokenize_html(HTML).title.strip() == "Tiger Habitat"
+
+
+class TestRanks:
+    def test_density_higher_for_shorter_sentence(self):
+        """A one-word title outranks a long body sentence in density
+        (reference getDensityRanks: 31 - (count-1))."""
+        ml = docproc.build_meta_list("http://a.com/", HTML)
+        f = posdb.unpack(ml.posdb_keys)
+        title_mask = f["hashgroup"] == posdb.HASHGROUP_TITLE
+        body_mask = f["hashgroup"] == posdb.HASHGROUP_BODY
+        assert f["densityrank"][title_mask].max() > \
+            f["densityrank"][body_mask].min()
+
+    def test_spam_rank_docked_for_repetition(self):
+        spammy = "buy " * 60 + "now this text has other words in it too " * 2
+        ml = docproc.build_meta_list("http://spam.com/", spammy, is_html=False)
+        f = posdb.unpack(ml.posdb_keys)
+        tid = ghash.term_id("buy")
+        spam_ranks = f["wordspamrank"][f["termid"] == tid]
+        assert len(spam_ranks) and spam_ranks.max() < posdb.MAXWORDSPAMRANK
+
+    def test_language_detected(self):
+        assert detect_language("the cat is on the mat with the dog".split()) \
+            == LANG_ENGLISH
+        assert detect_language(
+            "der hund und die katze sind nicht im haus".split()) == LANG_GERMAN
+
+
+class TestMetaList:
+    def test_bigrams_present(self):
+        ml = docproc.build_meta_list("http://a.com/", HTML)
+        f = posdb.unpack(ml.posdb_keys)
+        assert ghash.bigram_id("apex", "predators") in f["termid"]
+
+    def test_site_term_and_checksum_term(self):
+        ml = docproc.build_meta_list("http://www.a.com/x", HTML)
+        f = posdb.unpack(ml.posdb_keys)
+        assert ghash.term_id("www.a.com", prefix="site") in f["termid"]
+        assert f["shardbytermid"].sum() == 1  # exactly the checksum term
+
+    def test_delete_flag_makes_tombstones(self):
+        ml = docproc.build_meta_list("http://a.com/", HTML, delete=True)
+        f = posdb.unpack(ml.posdb_keys)
+        assert not f["delbit"].any()
+
+
+class TestIndexDocument:
+    def test_inject_and_read_back(self, tmp_path):
+        coll = Collection("main", tmp_path)
+        ml = docproc.index_document(coll, "http://example.com/tigers", HTML)
+        assert coll.num_docs == 1
+        # termlist for 'tiger' contains our doc
+        tid = ghash.term_id("tiger")
+        lst = coll.posdb.get_list(posdb.start_key(tid), posdb.end_key(tid))
+        f = posdb.unpack(lst.keys)
+        assert ml.docid in f["docid"]
+        # titlerec round-trips
+        rec = docproc.get_document(coll, "http://example.com/tigers")
+        assert rec["title"] == "Tiger Habitat"
+        assert rec["site"] == "example.com"
+
+    def test_delete_document(self, tmp_path):
+        coll = Collection("main", tmp_path)
+        docproc.index_document(coll, "http://example.com/t", HTML)
+        assert docproc.remove_document(coll, "http://example.com/t")
+        assert coll.num_docs == 0
+        tid = ghash.term_id("tiger")
+        lst = coll.posdb.get_list(posdb.start_key(tid), posdb.end_key(tid))
+        assert len(lst) == 0
+        assert docproc.get_document(coll, "http://example.com/t") is None
+
+    def test_reindex_replaces_not_duplicates(self, tmp_path):
+        coll = Collection("main", tmp_path)
+        docproc.index_document(coll, "http://a.com/", HTML)
+        html2 = "<html><title>New</title><body>leopard</body></html>"
+        docproc.index_document(coll, "http://a.com/", html2)
+        assert coll.num_docs == 1
+        # old terms gone, new terms present
+        tid_old = ghash.term_id("tiger")
+        tid_new = ghash.term_id("leopard")
+        assert len(coll.posdb.get_list(posdb.start_key(tid_old),
+                                       posdb.end_key(tid_old))) == 0
+        assert len(coll.posdb.get_list(posdb.start_key(tid_new),
+                                       posdb.end_key(tid_new))) == 1
+        assert docproc.get_document(coll, "http://a.com/")["title"] == "New"
+
+    def test_survives_dump_and_restart(self, tmp_path):
+        coll = Collection("main", tmp_path)
+        docproc.index_document(coll, "http://a.com/", HTML)
+        coll.dump_all()
+        coll.save()
+        coll2 = Collection("main", tmp_path)
+        assert docproc.get_document(coll2, "http://a.com/")["title"] \
+            == "Tiger Habitat"
+        tid = ghash.term_id("tiger")
+        assert len(coll2.posdb.get_list(posdb.start_key(tid),
+                                        posdb.end_key(tid))) > 0
