@@ -1,0 +1,17 @@
+"""Query plane: compiler → packer → device scorer → results.
+
+The TPU-native replacement for the reference's search layer (SURVEY §2.7):
+``Query.cpp`` (compiler), ``Msg2`` (termlist fetch), ``PosdbTable``
+(scoring kernel), ``TopTree`` (top-k), ``Msg40`` (orchestration).
+"""
+
+from .compiler import QueryPlan, TermGroup, compile_query
+from .engine import Result, SearchResults, search
+from .packer import PackedQuery, pack_pass, pack_query, prepare_query
+from .scorer import run_query, score_and_topk
+
+__all__ = [
+    "QueryPlan", "TermGroup", "compile_query", "Result", "SearchResults",
+    "search", "PackedQuery", "pack_pass", "pack_query", "prepare_query",
+    "run_query", "score_and_topk",
+]

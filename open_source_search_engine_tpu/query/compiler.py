@@ -1,0 +1,176 @@
+"""Query compiler — query string → term-group execution plan.
+
+Reference: ``Query.cpp/h`` (``Query::set2``: QueryWords → QueryTerms with
+bigrams/synonyms, fielded terms, quoted phrases, +/- signs) and
+``PosdbTable::setQueryTermInfo`` (``Posdb.cpp:4354``) which groups each
+term with its bigram/synonym variants into a QueryTermInfo whose sublists
+are mini-merged at scoring time.
+
+Supported subset (the reference's everyday operators; boolean expression
+trees and the ~100 SearchInput parms come with the API layer):
+
+* plain words → one required, scored group per word
+* adjacent-pair bigrams attached as sublists of the left word's group
+  (reference: bigram sublists share the leading word's position, so a doc
+  matching only the bigram still satisfies the group)
+* ``"quoted phrase"`` → each word required + the phrase's bigram chain as
+  *additional required groups* (positional adjacency enforced via the
+  indexed bigram terms rather than a separate phrase machine)
+* ``-word`` → negative group: matching docs are excluded
+  (reference BF_NEGATIVE)
+* ``site:example.com`` → required *filter* group on the site term
+  (scored=False — it gates matching but stays out of the min-score; the
+  reference carries fielded terms through scoring, but a constant-position
+  field term under the min-algorithm would dominate every query)
+
+Groups carry ``qpos`` (query word index); pair scoring uses the reference's
+default qdist=2 ("get query words as close together as possible",
+``Posdb.cpp:6886``).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from ..utils import ghash
+
+_WORD_RE = re.compile(r"\w+", re.UNICODE)
+# the '-' negation operator only binds at a token boundary (start of query
+# or after whitespace) so intra-word hyphens ("covid-19", "state-of-the-art")
+# never negate their tail words (reference QueryWord sign parsing requires
+# the minus to start the word, Query.cpp)
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<neg>(?:(?<=\s)|^)-)?
+    (?:
+        (?P<field>[a-zA-Z]+):(?P<fval>"[^"]*"|\S+)
+      | "(?P<quote>[^"]*)"
+      | (?P<word>\w+)
+    )
+    """,
+    re.UNICODE | re.VERBOSE,
+)
+
+#: fields that compile to prefix-hashed filter terms (reference Query.cpp
+#: field table — site:, inurl:, etc.; the rest arrive with the API layer)
+FILTER_FIELDS = {"site": "site", "inurl": "inurl", "gbcontenthash":
+                 "gbcontenthash"}
+
+#: sublist kinds (reference bigram flags BF_* on QueryTermInfo sublists)
+SUB_ORIGINAL = 0
+SUB_BIGRAM = 1
+SUB_SYNONYM = 2
+
+
+@dataclass
+class Sublist:
+    termid: int
+    kind: int  # SUB_*
+    display: str = ""
+
+
+@dataclass
+class TermGroup:
+    """One QueryTermInfo: a scoring unit whose sublists are mini-merged."""
+
+    display: str
+    sublists: list[Sublist] = field(default_factory=list)
+    required: bool = True
+    negative: bool = False
+    scored: bool = True
+    qpos: int = 0
+
+    @property
+    def termids(self) -> list[int]:
+        return [s.termid for s in self.sublists]
+
+
+@dataclass
+class QueryPlan:
+    raw: str
+    groups: list[TermGroup] = field(default_factory=list)
+    lang: int = 0  # 0 = any (reference &qlang)
+
+    @property
+    def scored_groups(self) -> list[TermGroup]:
+        return [g for g in self.groups if g.scored and not g.negative]
+
+    @property
+    def num_terms(self) -> int:
+        return len(self.groups)
+
+
+def compile_query(q: str, lang: int = 0,
+                  bigrams: bool = True) -> QueryPlan:
+    """Compile a query string into a :class:`QueryPlan`."""
+    plan = QueryPlan(raw=q, lang=lang)
+    qpos = 0
+    plain_words: list[tuple[int, str]] = []  # (group index, word)
+
+    for m in _TOKEN_RE.finditer(q):
+        neg = m.group("neg") is not None
+        if m.group("field") is not None:
+            fname = m.group("field").lower()
+            fval = m.group("fval").strip('"')
+            if fname in FILTER_FIELDS:
+                tid = ghash.term_id(fval, prefix=FILTER_FIELDS[fname])
+                plan.groups.append(TermGroup(
+                    display=f"{fname}:{fval}",
+                    sublists=[Sublist(tid, SUB_ORIGINAL, f"{fname}:{fval}")],
+                    negative=neg, scored=False, qpos=qpos))
+                qpos += 1
+            else:
+                # unknown field → treat the value as plain words
+                for w in _WORD_RE.findall(fval.lower()):
+                    plan.groups.append(_word_group(w, qpos, neg))
+                    if not neg:
+                        plain_words.append((len(plan.groups) - 1, w))
+                    qpos += 1
+        elif m.group("quote") is not None:
+            words = [w.lower() for w in _WORD_RE.findall(m.group("quote"))]
+            if neg and len(words) > 1:
+                # negated phrase: exclude docs matching the phrase, NOT docs
+                # containing any single word of it. One negative group on
+                # the bigram chain — exact for two-word phrases; for longer
+                # phrases it conservatively excludes any adjacent sub-pair
+                # (reference BF_NEGATIVE phrase semantics)
+                subs = [Sublist(ghash.bigram_id(a, b), SUB_BIGRAM, f"{a} {b}")
+                        for a, b in zip(words, words[1:])]
+                plan.groups.append(TermGroup(
+                    display='-"' + " ".join(words) + '"', sublists=subs,
+                    negative=True, scored=False, qpos=qpos))
+                qpos += len(words)
+                continue
+            for i, w in enumerate(words):
+                plan.groups.append(_word_group(w, qpos, neg))
+                qpos += 1
+                if i + 1 < len(words):
+                    # adjacency gate: the indexed bigram term must match too
+                    bid = ghash.bigram_id(w, words[i + 1])
+                    plan.groups.append(TermGroup(
+                        display=f'"{w} {words[i+1]}"',
+                        sublists=[Sublist(bid, SUB_BIGRAM)],
+                        negative=neg, scored=False, qpos=qpos))
+        else:
+            w = m.group("word").lower()
+            plan.groups.append(_word_group(w, qpos, neg))
+            if not neg:
+                plain_words.append((len(plan.groups) - 1, w))
+            qpos += 1
+
+    # attach adjacent-word bigrams as sublists of the left word's group
+    # (setQueryTermInfo: bigram termlists ride the leading term's group)
+    if bigrams:
+        for (gi, w1), (gj, w2) in zip(plain_words, plain_words[1:]):
+            if plan.groups[gi].qpos + 1 == plan.groups[gj].qpos:
+                plan.groups[gi].sublists.append(Sublist(
+                    ghash.bigram_id(w1, w2), SUB_BIGRAM, f"{w1} {w2}"))
+    return plan
+
+
+def _word_group(word: str, qpos: int, neg: bool) -> TermGroup:
+    return TermGroup(
+        display=word,
+        sublists=[Sublist(ghash.term_id(word), SUB_ORIGINAL, word)],
+        negative=neg, qpos=qpos)

@@ -1,0 +1,112 @@
+"""Search engine front end — query in, ranked results out.
+
+Reference: ``Msg40::getResults`` (``Msg40.cpp:171``) orchestrates
+Msg3a (docid ranking fan-out) then Msg20s (per-result title/summary); here
+the single-shard path is compile → pack → device score → titledb lookup.
+The mesh fan-out (Msg3a/shard_map) layers on top in ``parallel/``.
+
+Docid-range multipass (``Msg39.cpp:277-305`` "docid range splitting"): when
+the candidate set exceeds ``max_docs_per_pass``, the engine runs the kernel
+over candidate slices and merges top-k across passes — bounding device
+memory exactly like the reference bounds RAM.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..build import docproc
+from ..index.collection import Collection
+from ..utils.log import get_logger
+from .compiler import QueryPlan, compile_query
+from .packer import pack_pass, prepare_query
+from .scorer import run_query
+
+log = get_logger("query")
+
+
+@dataclass
+class Result:
+    docid: int
+    score: float
+    url: str = ""
+    title: str = ""
+    snippet: str = ""
+    site: str = ""
+
+
+@dataclass
+class SearchResults:
+    query: str
+    total_matches: int
+    results: list[Result] = field(default_factory=list)
+
+
+def _make_snippet(text: str, words: list[str], radius: int = 90) -> str:
+    """Cheap query-biased excerpt: window around the densest match region
+    (the full ``Summary::getBestWindow`` port lands with the Msg20 layer)."""
+    if not text:
+        return ""
+    low = text.lower()
+    hits = [low.find(w) for w in words]
+    hits = [h for h in hits if h >= 0]
+    if not hits:
+        return text[: 2 * radius].strip()
+    center = min(hits)
+    lo = max(0, center - radius)
+    hi = min(len(text), center + radius)
+    out = text[lo:hi].strip()
+    if lo > 0:
+        out = "…" + out
+    if hi < len(text):
+        out += "…"
+    return out
+
+
+def search(coll: Collection, q: str | QueryPlan, *, topk: int = 10,
+           lang: int = 0, max_docs_per_pass: int = 1 << 16,
+           with_snippets: bool = True) -> SearchResults:
+    """Execute a query against one collection (single shard)."""
+    plan = q if isinstance(q, QueryPlan) else compile_query(q, lang=lang)
+    raw = plan.raw
+
+    # docid-range multipass: fetch+intersect once, then score candidate
+    # slices, merging top-k across passes
+    all_docids: list[np.ndarray] = []
+    all_scores: list[np.ndarray] = []
+    total = 0
+    prep = prepare_query(coll, plan)
+    if prep is not None:
+        for offset in range(0, len(prep.cand), max_docs_per_pass):
+            pq = pack_pass(prep, doc_offset=offset,
+                           max_docs=max_docs_per_pass)
+            if pq is None:
+                break
+            docids, scores, n_matched = run_query(pq, topk=max(topk, 64))
+            total += n_matched
+            all_docids.append(docids)
+            all_scores.append(scores)
+
+    if not all_docids:
+        return SearchResults(query=raw, total_matches=0)
+    docids = np.concatenate(all_docids)
+    scores = np.concatenate(all_scores)
+    order = np.argsort(-scores, kind="stable")[:topk]
+
+    words = [g.display for g in plan.scored_groups]
+    results = []
+    for i in order:
+        if scores[i] <= 0:
+            break
+        rec = docproc.get_document(coll, docid=int(docids[i]))
+        r = Result(docid=int(docids[i]), score=float(scores[i]))
+        if rec:
+            r.url = rec.get("url", "")
+            r.title = rec.get("title", "")
+            r.site = rec.get("site", "")
+            if with_snippets:
+                r.snippet = _make_snippet(rec.get("text", ""), words)
+        results.append(r)
+    return SearchResults(query=raw, total_matches=total, results=results)

@@ -1,0 +1,276 @@
+"""Query packer — termlists → padded, statically-shaped device arrays.
+
+Reference seam: ``Msg2::getLists`` (fetch one RdbList per query term,
+``Msg2.cpp:30``) feeding ``PosdbTable::setQueryTermInfo``/``intersectLists10_r``
+(``Posdb.cpp:4354,5437``). The reference walks compressed byte lists per
+docid; a TPU wants dense masked tensors with static shapes. So the packer:
+
+1. fetches each group's sublists from the posdb Rdb and concatenates them
+   (the "mini-merge" of ``Posdb.cpp:6000ish`` done columnarly up front);
+2. picks the **driver**: the required group with the fewest unique docids
+   (reference: "pick smallest list as the driver", setQueryTermInfo) — only
+   its docids can match an AND query, so the candidate doc axis ``D`` is
+   bounded by the driver list length, not the corpus;
+3. maps every other list onto the candidate axis with ``searchsorted``
+   (host-side vectorized numpy — the CPU analog of the reference's key
+   compares, done once per query);
+4. emits padded arrays bucketed to powers of two so jit recompiles are
+   bounded: per (group, candidate-doc) up to ``P`` positions with a packed
+   uint32 payload (wordpos | hashgroup | density | spam | syn).
+
+Docid-range multipass (``Msg39.cpp:277-305``) maps to tiling the candidate
+axis: callers cap ``max_docs`` and the engine runs multiple passes, merging
+top-k across passes — same memory-bounding trick, TPU-shaped.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..index import posdb
+from ..index.collection import Collection
+from . import weights
+from .compiler import SUB_SYNONYM, QueryPlan
+
+#: max positions kept per (group, doc) — covers MAX_TOP=10 single-term
+#: slots plus slack for pair scoring (reference mini-merge buffers cap at
+#: MAX_SUBLISTS*256 bytes; we cap per-doc, which is what scoring consumes)
+MAX_POSITIONS = 16
+
+# packed payload bit layout (uint32)
+_POS_SHIFT = 0          # wordpos: 18 bits
+_HG_SHIFT = 18          # hashgroup: 4 bits
+_DEN_SHIFT = 22         # densityrank: 5 bits
+_SPAM_SHIFT = 27        # wordspamrank: 4 bits
+_SYN_SHIFT = 31         # synonym-ish (scored with SYNONYM_WEIGHT): 1 bit
+
+
+def _bucket(n: int, floor: int = 8) -> int:
+    """Next power of two ≥ n (≥ floor) — static-shape jit buckets."""
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
+@dataclass
+class PackedQuery:
+    """Device-ready query: everything the scorer jit consumes.
+
+    Shapes: T groups × L postings × (D docs × P positions after scatter).
+    All arrays numpy; the scorer moves them to device.
+    """
+
+    # per (group, posting): candidate-doc index, packed payload, position
+    # slot within (group,doc), validity
+    doc_idx: np.ndarray       # int32 [T, L]
+    payload: np.ndarray       # uint32 [T, L]
+    slot: np.ndarray          # int32 [T, L]
+    valid: np.ndarray         # bool [T, L]
+    # per group
+    freq_weight: np.ndarray   # float32 [T]
+    required: np.ndarray      # bool [T]
+    negative: np.ndarray      # bool [T]
+    scored: np.ndarray        # bool [T]
+    # per candidate doc
+    cand_docids: np.ndarray   # uint64 [D] (actual candidates; D_pad ≥ D)
+    siterank: np.ndarray      # int32 [D_pad]
+    doclang: np.ndarray       # int32 [D_pad]
+    n_docs: int               # real candidate count (≤ D_pad)
+    qlang: int
+
+    @property
+    def shape_key(self) -> tuple[int, int, int]:
+        return (self.doc_idx.shape[0], self.doc_idx.shape[1],
+                len(self.siterank))
+
+
+@dataclass
+class GroupList:
+    """One group's fetched+merged postings (columnar)."""
+
+    docids: np.ndarray     # uint64, sorted
+    payload: np.ndarray    # uint32, parallel
+    siterank: np.ndarray   # int32, parallel (per posting, from the key)
+    langid: np.ndarray     # int32, parallel
+
+
+def fetch_group_lists(coll: Collection, plan: QueryPlan) -> list[GroupList]:
+    """Msg2 equivalent: fetch every group's sublists and mini-merge."""
+    out = []
+    for g in plan.groups:
+        cols = {"docids": [], "payload": [], "siterank": [], "langid": []}
+        for sub in g.sublists:
+            batch = coll.posdb.get_list(posdb.start_key(sub.termid),
+                                        posdb.end_key(sub.termid))
+            if not len(batch):
+                continue
+            f = posdb.unpack(batch.keys)
+            syn = np.uint32(1 if sub.kind == SUB_SYNONYM else 0)
+            payload = (
+                f["wordpos"].astype(np.uint32) << np.uint32(_POS_SHIFT)
+                | f["hashgroup"].astype(np.uint32) << np.uint32(_HG_SHIFT)
+                | f["densityrank"].astype(np.uint32) << np.uint32(_DEN_SHIFT)
+                | f["wordspamrank"].astype(np.uint32) << np.uint32(_SPAM_SHIFT)
+                | syn << np.uint32(_SYN_SHIFT)
+            )
+            cols["docids"].append(f["docid"])
+            cols["payload"].append(payload)
+            cols["siterank"].append(f["siterank"].astype(np.int32))
+            cols["langid"].append(f["langid"].astype(np.int32))
+        if cols["docids"]:
+            docids = np.concatenate(cols["docids"])
+            order = np.argsort(docids, kind="stable")
+            out.append(GroupList(
+                docids=docids[order],
+                payload=np.concatenate(cols["payload"])[order],
+                siterank=np.concatenate(cols["siterank"])[order],
+                langid=np.concatenate(cols["langid"])[order]))
+        else:
+            out.append(GroupList(
+                docids=np.empty(0, np.uint64),
+                payload=np.empty(0, np.uint32),
+                siterank=np.empty(0, np.int32),
+                langid=np.empty(0, np.int32)))
+    return out
+
+
+@dataclass
+class PreparedQuery:
+    """Fetch+intersect product, computed ONCE per query: multipass slices
+    ``cand`` without re-reading the Rdb (the reference's docid-range passes
+    likewise reuse the Msg2 lists already in RAM, ``Msg39.cpp:277``)."""
+
+    plan: QueryPlan
+    lists: list[GroupList]
+    cand: np.ndarray          # uint64, all candidate docids (sorted)
+    driver: int
+    freq_weight: np.ndarray   # float32 [T]
+
+
+def prepare_query(coll: Collection, plan: QueryPlan) -> PreparedQuery | None:
+    """Fetch termlists, pick the driver, intersect candidates.
+
+    Returns None when no doc can match (an empty required list — the
+    reference's early-out when a termlist is empty, ``Msg39.cpp``).
+    """
+    lists = fetch_group_lists(coll, plan)
+    req = [i for i, g in enumerate(plan.groups)
+           if g.required and not g.negative]
+    if not req:
+        return None
+    for i in req:
+        if not len(lists[i].docids):
+            return None  # AND with an empty list matches nothing
+
+    # driver = required group with fewest unique docids
+    uniques = {i: np.unique(lists[i].docids) for i in req}
+    driver = min(req, key=lambda i: len(uniques[i]))
+    cand = uniques[driver]
+    # intersect with every other required group's docids (cheap host-side
+    # pre-intersection; the device re-checks presence per term anyway)
+    for i in req:
+        if i != driver and len(cand):
+            cand = cand[np.isin(cand, uniques[i], assume_unique=True)]
+    if not len(cand):
+        return None
+
+    # term-frequency weights from unique-doc counts (reuse the uniques
+    # already computed for required groups; only scored groups' weights
+    # feed the kernel, and scored ⊆ required)
+    nd = max(coll.num_docs, 1)
+    freqw = np.array(
+        [float(weights.term_freq_weight(len(uniques[i]), nd))
+         if i in uniques else 0.5 for i in range(len(lists))],
+        dtype=np.float32)
+    return PreparedQuery(plan=plan, lists=lists, cand=cand, driver=driver,
+                         freq_weight=freqw)
+
+
+def pack_pass(prep: PreparedQuery, doc_offset: int = 0,
+              max_docs: int | None = None,
+              max_positions: int = MAX_POSITIONS) -> PackedQuery | None:
+    """Build the PackedQuery for one docid-range pass over the prepared
+    candidates (slice [doc_offset : doc_offset+max_docs])."""
+    plan, lists = prep.plan, prep.lists
+    if max_docs is not None:
+        cand = prep.cand[doc_offset:doc_offset + max_docs]
+    else:
+        cand = prep.cand[doc_offset:] if doc_offset else prep.cand
+    if not len(cand):
+        return None
+
+    T = len(plan.groups)
+    D = len(cand)
+    D_pad = _bucket(D)
+
+    per_group = []
+    max_kept = 1
+    for gl in lists:
+        if not len(gl.docids):
+            per_group.append((np.empty(0, np.int32), np.empty(0, np.uint32),
+                              np.empty(0, np.int32)))
+            continue
+        pos_in_cand = np.searchsorted(cand, gl.docids)
+        pos_in_cand_c = np.clip(pos_in_cand, 0, D - 1)
+        hit = cand[pos_in_cand_c] == gl.docids
+        didx = pos_in_cand_c[hit].astype(np.int32)
+        payload = gl.payload[hit]
+        # occurrence slot within each (group, doc) run; postings are sorted
+        # by docid then wordpos (posdb key order), so runs are contiguous
+        if len(didx):
+            run_start = np.r_[0, np.nonzero(np.diff(didx))[0] + 1]
+            slot = (np.arange(len(didx))
+                    - np.repeat(run_start, np.diff(np.r_[run_start, len(didx)]))
+                    ).astype(np.int32)
+            keep = slot < max_positions
+            didx, payload, slot = didx[keep], payload[keep], slot[keep]
+            max_kept = max(max_kept, len(didx))
+        else:
+            slot = np.empty(0, np.int32)
+        per_group.append((didx, payload, slot))
+
+    L = _bucket(max_kept)
+    doc_idx = np.full((T, L), D_pad, dtype=np.int32)  # D_pad = drop row
+    payload = np.zeros((T, L), dtype=np.uint32)
+    slot = np.zeros((T, L), dtype=np.int32)
+    valid = np.zeros((T, L), dtype=bool)
+    for t, (didx, pl, sl) in enumerate(per_group):
+        n = len(didx)
+        doc_idx[t, :n] = didx
+        payload[t, :n] = pl
+        slot[t, :n] = sl
+        valid[t, :n] = True
+
+    # per-candidate-doc siterank/langid from the driver group's first
+    # posting (reference: getSiteRank(miniMergedList[0]), Posdb.cpp:6989)
+    siterank = np.zeros(D_pad, dtype=np.int32)
+    doclang = np.zeros(D_pad, dtype=np.int32)
+    gl = lists[prep.driver]
+    first = np.searchsorted(gl.docids, cand)
+    siterank[:D] = gl.siterank[np.clip(first, 0, len(gl.docids) - 1)]
+    doclang[:D] = gl.langid[np.clip(first, 0, len(gl.docids) - 1)]
+
+    return PackedQuery(
+        doc_idx=doc_idx, payload=payload, slot=slot, valid=valid,
+        freq_weight=prep.freq_weight,
+        required=np.array([g.required and not g.negative
+                           for g in plan.groups]),
+        negative=np.array([g.negative for g in plan.groups]),
+        scored=np.array([g.scored and not g.negative
+                         for g in plan.groups]),
+        cand_docids=cand,
+        siterank=siterank, doclang=doclang,
+        n_docs=D, qlang=plan.lang)
+
+
+def pack_query(coll: Collection, plan: QueryPlan,
+               doc_offset: int = 0,
+               max_docs: int | None = None) -> PackedQuery | None:
+    """One-shot convenience: prepare + pack a single pass."""
+    prep = prepare_query(coll, plan)
+    if prep is None:
+        return None
+    return pack_pass(prep, doc_offset=doc_offset, max_docs=max_docs)

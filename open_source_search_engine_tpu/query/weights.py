@@ -1,0 +1,112 @@
+"""Scoring weight tables — faithful to the reference's ``initWeights``
+(``Posdb.cpp:1105-1197``) and scoring constants (``Posdb.h:94-117``).
+
+Every weight is applied *squared* in single-term scoring and once per side
+in pair scoring (``getSingleTermScore`` ``Posdb.cpp:3087``,
+``getTermPairScoreForWindow`` ``Posdb.cpp:3557``), so tables here hold the
+raw (unsquared) values exactly as the reference's static arrays do.
+
+Tables are plain numpy float32; the scorer lifts them to device constants
+inside jit (they are closure constants, folded by XLA).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..index.posdb import (
+    HASHGROUP_BODY, HASHGROUP_END, HASHGROUP_HEADING, HASHGROUP_INLINKTEXT,
+    HASHGROUP_INLIST, HASHGROUP_INMENU, HASHGROUP_INMETATAG, HASHGROUP_INTAG,
+    HASHGROUP_INTERNALINLINKTEXT, HASHGROUP_INURL, HASHGROUP_NEIGHBORHOOD,
+    HASHGROUP_TITLE, MAXDENSITYRANK, MAXDIVERSITYRANK, MAXWORDSPAMRANK,
+)
+
+# scoring constants (Posdb.h:94-117, 765, 817)
+SYNONYM_WEIGHT = 0.90
+WIKI_WEIGHT = 0.10
+SITERANKMULTIPLIER = 0.33333333
+WIKI_BIGRAM_WEIGHT = 1.40
+FIXED_DISTANCE = 400
+MAX_TOP = 10
+#: default same-language boost (Parms.cpp "language weight" m_def 20.0)
+SAME_LANG_WEIGHT = 20.0
+#: pairs of non-body positions >50 apart get FIXED_DISTANCE
+#: (Posdb.cpp:3372 "fix distance if in different non-body hashgroups")
+NONBODY_DIST_CAP = 50
+
+BASE_SCORE = 100.0  # every position/pair score starts at 100 (Posdb.cpp:3118)
+
+
+def _hash_group_weights() -> np.ndarray:
+    w = np.zeros(HASHGROUP_END, dtype=np.float32)
+    w[HASHGROUP_BODY] = 1.0
+    w[HASHGROUP_TITLE] = 8.0
+    w[HASHGROUP_HEADING] = 1.5
+    w[HASHGROUP_INLIST] = 0.3
+    w[HASHGROUP_INMETATAG] = 0.1
+    w[HASHGROUP_INLINKTEXT] = 16.0
+    w[HASHGROUP_INTAG] = 1.0
+    w[HASHGROUP_NEIGHBORHOOD] = 0.0
+    w[HASHGROUP_INTERNALINLINKTEXT] = 4.0
+    w[HASHGROUP_INURL] = 1.0
+    w[HASHGROUP_INMENU] = 0.2
+    return w
+
+
+def _density_weights() -> np.ndarray:
+    # sum starts at 0.35, *= 1.03445 per rank, clamped at 1.0
+    # (Posdb.cpp:1117-1125)
+    w = np.zeros(MAXDENSITYRANK + 1, dtype=np.float32)
+    s = 0.35
+    for i in range(MAXDENSITYRANK + 1):
+        w[i] = min(s, 1.0)
+        s *= 1.03445
+    return w
+
+
+def _diversity_weights() -> np.ndarray:
+    # disabled in the reference: all 1.0 (Posdb.cpp:1112)
+    return np.ones(MAXDIVERSITYRANK + 1, dtype=np.float32)
+
+
+def _word_spam_weights() -> np.ndarray:
+    # (i+1)/(MAX+1) (Posdb.cpp:1128-1129)
+    return ((np.arange(MAXWORDSPAMRANK + 1) + 1.0)
+            / (MAXWORDSPAMRANK + 1)).astype(np.float32)
+
+
+def _linker_weights() -> np.ndarray:
+    # sqrt(1+i) — inlink text spam slot stores the linker's siterank
+    # (Posdb.cpp:1136-1137)
+    return np.sqrt(1.0 + np.arange(MAXWORDSPAMRANK + 1)).astype(np.float32)
+
+
+def _in_body() -> np.ndarray:
+    # body-ish hashgroups (Posdb.cpp:1142-1148)
+    b = np.zeros(HASHGROUP_END, dtype=bool)
+    for hg in (HASHGROUP_BODY, HASHGROUP_HEADING, HASHGROUP_INLIST,
+               HASHGROUP_INMENU):
+        b[hg] = True
+    return b
+
+
+HASH_GROUP_WEIGHTS = _hash_group_weights()
+DENSITY_WEIGHTS = _density_weights()
+DIVERSITY_WEIGHTS = _diversity_weights()
+WORD_SPAM_WEIGHTS = _word_spam_weights()
+LINKER_WEIGHTS = _linker_weights()
+IN_BODY = _in_body()
+
+#: mapped hashgroup for single-term dedup: body-ish groups collapse to BODY
+#: (Posdb.cpp:3126-3127 "if s_inBody[mhg] mhg = HASHGROUP_BODY")
+MAPPED_HASHGROUP = np.where(
+    IN_BODY, HASHGROUP_BODY, np.arange(HASHGROUP_END)).astype(np.int32)
+
+
+def term_freq_weight(term_freq, num_docs) -> np.ndarray:
+    """IDF-ish weight in [0.5, 1.0]: 0.5 + min(tf/N, 0.5)
+    (``getTermFreqWeight`` ``Posdb.cpp:1225-1252`` — *inverted* because the
+    min-algorithm needs common terms to score higher, not lower)."""
+    tf = np.asarray(term_freq, dtype=np.float32)
+    n = max(float(num_docs), 1.0)
+    return (0.5 + np.minimum(tf / n, 0.5)).astype(np.float32)

@@ -1,0 +1,305 @@
+"""Query plane tests — compiler, packer, scorer, end-to-end search.
+
+Modeled on the reference QA strategy (SURVEY §4): inject a small fixture
+corpus, run queries, assert ranking-relevant invariants (the ``qainject``/
+``qaSyntax`` pattern from ``qa.cpp:659,1163`` — inject then query every
+operator), plus unit checks of scoring semantics against hand-computed
+values from the reference weight tables.
+"""
+
+import numpy as np
+import pytest
+
+from open_source_search_engine_tpu.build import docproc
+from open_source_search_engine_tpu.index.collection import Collection
+from open_source_search_engine_tpu.query import compiler, engine, packer, scorer
+from open_source_search_engine_tpu.query import weights
+
+
+# ---------------------------------------------------------------------------
+# compiler
+# ---------------------------------------------------------------------------
+
+class TestCompiler:
+    def test_plain_words(self):
+        plan = compiler.compile_query("street light")
+        assert len(plan.groups) == 2
+        assert all(g.required and g.scored for g in plan.groups)
+        # left word carries the bigram sublist
+        assert len(plan.groups[0].sublists) == 2
+        assert plan.groups[0].sublists[1].kind == compiler.SUB_BIGRAM
+        assert len(plan.groups[1].sublists) == 1
+
+    def test_negative(self):
+        plan = compiler.compile_query("apple -banana")
+        assert plan.groups[0].negative is False
+        assert plan.groups[1].negative is True
+        # no bigram across a negative term
+        assert len(plan.groups[0].sublists) == 1
+
+    def test_site_filter(self):
+        plan = compiler.compile_query("news site:example.com")
+        assert len(plan.groups) == 2
+        f = plan.groups[1]
+        assert f.scored is False and f.required is True
+
+    def test_quoted_phrase(self):
+        plan = compiler.compile_query('"new york city"')
+        # 3 word groups + 2 adjacency (bigram) gate groups
+        kinds = [(g.scored, g.required) for g in plan.groups]
+        assert len(plan.groups) == 5
+        assert kinds.count((False, True)) == 2
+
+    def test_same_word_same_termid(self):
+        a = compiler.compile_query("Apple")
+        b = compiler.compile_query("apple")
+        assert a.groups[0].termids == b.groups[0].termids
+
+    def test_hyphenated_word_is_not_negation(self):
+        plan = compiler.compile_query("covid-19 state-of-the-art")
+        assert not any(g.negative for g in plan.groups)
+        assert [g.display for g in plan.groups] == \
+               ["covid", "19", "state", "of", "the", "art"]
+
+    def test_negated_phrase_single_group(self):
+        plan = compiler.compile_query('apple -"new york"')
+        negs = [g for g in plan.groups if g.negative]
+        assert len(negs) == 1
+        # one bigram sublist, not per-word negative groups
+        assert len(negs[0].sublists) == 1
+        assert negs[0].sublists[0].kind == compiler.SUB_BIGRAM
+
+
+# ---------------------------------------------------------------------------
+# end-to-end fixture corpus (qainject pattern)
+# ---------------------------------------------------------------------------
+
+DOCS = {
+    "http://fruits.example.com/apple": """
+      <html><head><title>All about apples</title></head><body>
+      <h1>Apple varieties</h1>
+      <p>The apple is a sweet fruit. Apples are grown worldwide.
+      An apple tree takes years to mature. Apple pie is popular.</p>
+      </body></html>""",
+    "http://fruits.example.com/banana": """
+      <html><head><title>Banana facts</title></head><body>
+      <p>The banana is a tropical fruit. Bananas are rich in potassium.
+      A banana plant is technically an herb.</p></body></html>""",
+    "http://veg.example.org/carrot": """
+      <html><head><title>Carrot guide</title></head><body>
+      <p>The carrot is a root vegetable. Carrots contain carotene.
+      Some say a carrot a day keeps the optometrist away. The fruit
+      comparison is unfair to the humble carrot.</p></body></html>""",
+    "http://news.example.net/fruit-market": """
+      <html><head><title>Fruit market report</title></head><body>
+      <p>Apple and banana prices rose this week at the fruit market.
+      The market for tropical fruit keeps growing. Traders expect
+      banana supply to recover.</p></body></html>""",
+}
+
+
+@pytest.fixture(scope="class")
+def coll(tmp_path_factory):
+    c = Collection("qtest", tmp_path_factory.mktemp("qtest"))
+    for url, html in DOCS.items():
+        docproc.index_document(c, url, html)
+    return c
+
+
+class TestEndToEnd:
+    def test_single_term(self, coll):
+        res = engine.search(coll, "banana", topk=10)
+        urls = [r.url for r in res.results]
+        assert "http://fruits.example.com/banana" in urls
+        assert "http://news.example.net/fruit-market" in urls
+        assert "http://fruits.example.com/apple" not in urls
+        # title hit + higher density should rank the banana page first
+        assert urls[0] == "http://fruits.example.com/banana"
+
+    def test_and_semantics(self, coll):
+        res = engine.search(coll, "apple banana", topk=10)
+        urls = {r.url for r in res.results}
+        assert urls == {"http://news.example.net/fruit-market"}
+
+    def test_negative_excludes(self, coll):
+        res = engine.search(coll, "fruit -banana", topk=10)
+        urls = {r.url for r in res.results}
+        assert "http://news.example.net/fruit-market" not in urls
+        assert "http://fruits.example.com/banana" not in urls
+        assert "http://fruits.example.com/apple" in urls
+        assert "http://veg.example.org/carrot" in urls
+
+    def test_site_filter(self, coll):
+        res = engine.search(coll, "fruit site:fruits.example.com", topk=10)
+        urls = {r.url for r in res.results}
+        assert urls == {"http://fruits.example.com/apple",
+                        "http://fruits.example.com/banana"}
+
+    def test_quoted_phrase(self, coll):
+        res = engine.search(coll, '"root vegetable"', topk=10)
+        urls = {r.url for r in res.results}
+        assert urls == {"http://veg.example.org/carrot"}
+        # words present but never adjacent in any doc → no matches
+        res2 = engine.search(coll, '"vegetable root"', topk=10)
+        assert not res2.results
+
+    def test_no_match(self, coll):
+        res = engine.search(coll, "zeppelin", topk=10)
+        assert res.total_matches == 0 and not res.results
+
+    def test_snippets_and_titles(self, coll):
+        res = engine.search(coll, "carotene", topk=5)
+        assert res.results[0].title == "Carrot guide"
+        assert "carotene" in res.results[0].snippet.lower()
+
+    def test_delete_then_search(self, coll):
+        url = "http://tmp.example.com/doomed"
+        docproc.index_document(
+            coll, url, "<html><title>Doomed</title>"
+            "<body>xylophone quartz doomed page</body></html>")
+        assert any(r.url == url for r in
+                   engine.search(coll, "xylophone").results)
+        docproc.remove_document(coll, url)
+        assert not engine.search(coll, "xylophone").results
+
+    def test_negated_phrase_keeps_word_matches(self, coll):
+        # "tropical fruit" appears in banana + market docs; carrot has
+        # "fruit" alone and must survive the phrase negation
+        res = engine.search(coll, 'fruit -"tropical fruit"', topk=10)
+        urls = {r.url for r in res.results}
+        assert "http://veg.example.org/carrot" in urls
+        assert "http://fruits.example.com/banana" not in urls
+        assert "http://news.example.net/fruit-market" not in urls
+
+    def test_bare_site_filter_query(self, coll):
+        res = engine.search(coll, "site:fruits.example.com", topk=10)
+        urls = {r.url for r in res.results}
+        assert urls == {"http://fruits.example.com/apple",
+                        "http://fruits.example.com/banana"}
+
+    def test_total_matches_counts_all(self, coll):
+        res = engine.search(coll, "fruit", topk=1)
+        assert len(res.results) == 1
+        assert res.total_matches == 4  # every fixture doc contains "fruit"
+
+    def test_multipass_matches_single_pass(self, coll):
+        full = engine.search(coll, "fruit", topk=10)
+        paged = engine.search(coll, "fruit", topk=10, max_docs_per_pass=2)
+        assert [r.docid for r in full.results] == \
+               [r.docid for r in paged.results]
+        assert [round(r.score, 3) for r in full.results] == \
+               [round(r.score, 3) for r in paged.results]
+
+
+# ---------------------------------------------------------------------------
+# scoring semantics (hand-checked against reference weight math)
+# ---------------------------------------------------------------------------
+
+class TestScoringSemantics:
+    def _one_doc_pq(self, payloads_by_term, n_docs=1, freqw=None,
+                    siterank=0):
+        """Build a minimal PackedQuery by hand: one candidate doc, T terms,
+        each with a list of packed (wordpos, hg, den, spam, syn)."""
+        T = len(payloads_by_term)
+        L = max(max((len(p) for p in payloads_by_term), default=1), 1)
+        L = packer._bucket(L)
+        doc_idx = np.full((T, L), 1, np.int32)  # 1 == dump row for D=1
+        payload = np.zeros((T, L), np.uint32)
+        slot = np.zeros((T, L), np.int32)
+        valid = np.zeros((T, L), bool)
+        for t, plist in enumerate(payloads_by_term):
+            for i, (wp, hg, den, spam, syn) in enumerate(plist):
+                doc_idx[t, i] = 0
+                payload[t, i] = (wp | (hg << 18) | (den << 22)
+                                 | (spam << 27) | (syn << 31))
+                slot[t, i] = i
+                valid[t, i] = True
+        return packer.PackedQuery(
+            doc_idx=doc_idx, payload=payload, slot=slot, valid=valid,
+            freq_weight=np.array(freqw or [0.5] * T, np.float32),
+            required=np.ones(T, bool), negative=np.zeros(T, bool),
+            scored=np.ones(T, bool),
+            cand_docids=np.array([1234], np.uint64),
+            siterank=np.full(1, siterank, np.int32),
+            doclang=np.zeros(1, np.int32), n_docs=1, qlang=0)
+
+    def test_single_term_body_score(self):
+        # one body position, density rank 25, no spam (15), no syn
+        den = 25
+        pq = self._one_doc_pq([[(100, 0, den, 15, 0)]])
+        docids, scores, _ = scorer.run_query(pq, topk=4)
+        dw = weights.DENSITY_WEIGHTS[den]
+        expect = (100.0 * (1.0 * dw * 1.0) ** 2      # hgw=1 body, spamw=1
+                  * 0.5 * 0.5                        # freqw²
+                  * 1.0                              # siterank 0 → ×1
+                  * weights.SAME_LANG_WEIGHT)
+        assert scores[0] == pytest.approx(expect, rel=1e-5)
+
+    def test_title_beats_body(self):
+        body = self._one_doc_pq([[(100, 0, 25, 15, 0)]])
+        title = self._one_doc_pq([[(100, 1, 25, 15, 0)]])
+        _, sb, _ = scorer.run_query(body, topk=1)
+        _, st, _ = scorer.run_query(title, topk=1)
+        assert st[0] == pytest.approx(sb[0] * 64.0, rel=1e-5)  # 8² hgw
+
+    def test_pair_distance_decay(self):
+        # two terms in body, close vs far: score ∝ 1/(dist-qdist+1)
+        def pair_pq(gap):
+            return self._one_doc_pq(
+                [[(100, 0, 31, 15, 0)], [(100 + gap, 0, 31, 15, 0)]])
+        _, s_close, _ = scorer.run_query(pair_pq(2), topk=1)
+        _, s_far, _ = scorer.run_query(pair_pq(12), topk=1)
+        # dist 2-qdist=0 → /1 ; dist 12-qdist=10 → /11
+        assert s_close[0] == pytest.approx(s_far[0] * 11.0, rel=1e-4)
+
+    def test_out_of_order_penalty(self):
+        fwd = self._one_doc_pq(
+            [[(100, 0, 31, 15, 0)], [(110, 0, 31, 15, 0)]])
+        rev = self._one_doc_pq(
+            [[(110, 0, 31, 15, 0)], [(100, 0, 31, 15, 0)]])
+        _, sf, _ = scorer.run_query(fwd, topk=1)
+        _, sr, _ = scorer.run_query(rev, topk=1)
+        assert sf[0] > sr[0]
+
+    def test_siterank_multiplier(self):
+        lo = self._one_doc_pq([[(100, 0, 31, 15, 0)]], siterank=0)
+        hi = self._one_doc_pq([[(100, 0, 31, 15, 0)]], siterank=9)
+        _, sl, _ = scorer.run_query(lo, topk=1)
+        _, sh, _ = scorer.run_query(hi, topk=1)
+        assert sh[0] == pytest.approx(
+            sl[0] * (9 * weights.SITERANKMULTIPLIER + 1.0), rel=1e-5)
+
+    def test_min_algorithm_takes_weakest_term(self):
+        # term B has worse density → min(single) should reflect B
+        pq = self._one_doc_pq([[(100, 0, 31, 15, 0)],
+                               [(300, 0, 5, 15, 0)]])
+        pq_both_good = self._one_doc_pq([[(100, 0, 31, 15, 0)],
+                                         [(300, 0, 31, 15, 0)]])
+        _, s_mixed, _ = scorer.run_query(pq, topk=1)
+        _, s_good, _ = scorer.run_query(pq_both_good, topk=1)
+        assert s_mixed[0] < s_good[0]
+
+    def test_inlink_text_positions_sum(self):
+        # multiple inlink-text hits add up (no mapped-group dedup),
+        # repeated body hits dedup to the best one
+        inlink2 = self._one_doc_pq(
+            [[(0, 5, 31, 3, 0), (60, 5, 31, 3, 0)]])
+        inlink1 = self._one_doc_pq([[(0, 5, 31, 3, 0)]])
+        body2 = self._one_doc_pq(
+            [[(100, 0, 31, 15, 0), (160, 0, 31, 15, 0)]])
+        body1 = self._one_doc_pq([[(100, 0, 31, 15, 0)]])
+        _, si2, _ = scorer.run_query(inlink2, topk=1)
+        _, si1, _ = scorer.run_query(inlink1, topk=1)
+        _, sb2, _ = scorer.run_query(body2, topk=1)
+        _, sb1, _ = scorer.run_query(body1, topk=1)
+        assert si2[0] == pytest.approx(si1[0] * 2.0, rel=1e-5)
+        assert sb2[0] == pytest.approx(sb1[0], rel=1e-5)
+
+    def test_weight_tables_match_reference_formulas(self):
+        assert weights.DENSITY_WEIGHTS[0] == pytest.approx(0.35)
+        assert weights.DENSITY_WEIGHTS[31] == pytest.approx(1.0)
+        assert weights.WORD_SPAM_WEIGHTS[15] == pytest.approx(1.0)
+        assert weights.WORD_SPAM_WEIGHTS[0] == pytest.approx(1.0 / 16)
+        assert weights.LINKER_WEIGHTS[15] == pytest.approx(4.0)
+        assert weights.HASH_GROUP_WEIGHTS[1] == 8.0   # title
+        assert weights.HASH_GROUP_WEIGHTS[5] == 16.0  # inlink text
