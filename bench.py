@@ -1,0 +1,99 @@
+"""Benchmark: query throughput on one device vs the reference baseline.
+
+Reference baseline (BASELINE.md / ``html/faq.html:320``): ~8 queries/sec
+on a 10M-page index on 2010-era hardware (dual quad-core, 8 gb
+instances). BASELINE.json's measurable config here: conjunctive AND +
+single-term queries over a synthetic corpus on one chip — the
+``PosdbTable::intersectLists10_r`` path (device kernel) plus the host
+pack (Msg2 equivalent).
+
+Prints exactly ONE JSON line:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_QPS = 8.0  # html/faq.html:320
+
+N_DOCS = int(os.environ.get("BENCH_DOCS", "2000"))
+N_QUERIES = int(os.environ.get("BENCH_QUERIES", "200"))
+
+
+def _build_corpus(coll, n_docs: int) -> list[str]:
+    """Synthetic zipf-vocabulary corpus; returns the vocabulary."""
+    import numpy as np
+
+    from open_source_search_engine_tpu.build import docproc
+
+    rng = np.random.default_rng(42)
+    vocab = [f"word{i}" for i in range(2000)]
+    varr = np.array(vocab)
+    for d in range(n_docs):
+        n_words = int(rng.integers(60, 220))
+        idx = rng.zipf(1.35, size=n_words) % len(vocab)
+        words = varr[idx]
+        title = " ".join(words[:4])
+        sents = []
+        for s in range(0, n_words, 12):
+            sents.append(" ".join(words[s:s + 12]) + ".")
+        docproc.index_document(
+            coll, f"http://bench.test/site{d % 97}/doc{d}",
+            f"<html><head><title>{title}</title></head><body><p>"
+            + " ".join(sents) + "</p></body></html>")
+    return vocab
+
+
+def _make_queries(vocab: list[str], n: int) -> list[str]:
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    qs = []
+    for i in range(n):
+        n_terms = int(rng.integers(1, 4))  # 1-3 term AND queries
+        terms = rng.zipf(1.3, size=n_terms) % len(vocab)
+        qs.append(" ".join(vocab[t] for t in terms))
+    return qs
+
+
+def main() -> None:
+    from open_source_search_engine_tpu.index.collection import Collection
+    from open_source_search_engine_tpu.query import engine
+
+    coll = Collection("bench", tempfile.mkdtemp(prefix="osse_bench_"))
+    _t0 = time.perf_counter()
+    vocab = _build_corpus(coll, N_DOCS)
+    build_s = time.perf_counter() - _t0
+    queries = _make_queries(vocab, N_QUERIES)
+
+    # warmup: populate the jit cache for every shape bucket
+    for q in queries:
+        engine.search(coll, q, topk=10, with_snippets=False)
+
+    t0 = time.perf_counter()
+    for q in queries:
+        engine.search(coll, q, topk=10, with_snippets=False)
+    elapsed = time.perf_counter() - t0
+
+    qps = N_QUERIES / elapsed
+    print(json.dumps({
+        "metric": "queries_per_sec",
+        "value": round(qps, 2),
+        "unit": "qps",
+        "vs_baseline": round(qps / BASELINE_QPS, 2),
+    }))
+    print(f"# corpus={N_DOCS} docs ({build_s:.1f}s build), "
+          f"{N_QUERIES} queries in {elapsed:.2f}s, "
+          f"p50 latency ~{1000 * elapsed / N_QUERIES:.1f}ms",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

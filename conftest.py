@@ -11,3 +11,8 @@ import os
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+# the axon TPU plugin ignores JAX_PLATFORMS; the config route sticks
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

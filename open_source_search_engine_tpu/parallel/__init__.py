@@ -1,0 +1,14 @@
+"""Distributed plane: device mesh, docid sharding, scatter-gather query.
+
+The TPU-native replacement for the reference's cluster layer (SURVEY
+§2.4-2.5): ``Hostdb`` (cluster map) → :mod:`hostmap`; Msg1/Msg4 sharded
+record routing → :class:`sharded.ShardedCollection` adds; Msg3a/Msg39
+scatter-gather with per-shard intersect + cross-shard top-k merge →
+``shard_map`` over a ``jax.sharding.Mesh`` with an in-mesh all-gather
+merge (ICI collectives instead of reliable-UDP fan-out).
+"""
+
+from .hostmap import HostMap, make_mesh
+from .sharded import ShardedCollection, sharded_search
+
+__all__ = ["HostMap", "make_mesh", "ShardedCollection", "sharded_search"]

@@ -1,0 +1,80 @@
+"""Host/shard map — the cluster topology (Hostdb equivalent).
+
+Reference: ``Hostdb.cpp/h`` — parses ``hosts.conf`` (``num-mirrors:``,
+``index-splits:``, host lines, ``Hostdb.cpp:124``), maps keys to shards
+(``getShardNum`` ``Hostdb.cpp:2486``), tracks per-host liveness for
+failover. On TPU the "hosts" of one slice are mesh devices: one chip ≈ one
+index shard (document partition); replicas (the reference's "twins",
+``num-mirrors:``) become a second mesh axis when configured, served across
+DCN for availability rather than intra-query.
+
+The docid→shard function lives in :mod:`..index.posdb`
+(``shard_of_docid``/``shard_of_keys``) so the build plane routes records
+identically — this module owns topology + mesh construction.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..index import posdb
+
+SHARD_AXIS = "shards"
+REPLICA_AXIS = "replicas"
+
+
+def make_mesh(n_shards: int | None = None,
+              n_replicas: int = 1,
+              devices=None) -> Mesh:
+    """Build the query mesh: ``shards`` (× optional ``replicas``) axes.
+
+    With ``n_shards=None`` all visible devices become shards (the
+    reference's default one-host-per-shard ``hosts.conf``).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n_shards is None:
+        n_shards = len(devices) // n_replicas
+    need = n_shards * n_replicas
+    if need > len(devices):
+        raise ValueError(
+            f"mesh needs {need} devices ({n_shards} shards × "
+            f"{n_replicas} replicas) but only {len(devices)} visible")
+    arr = np.array(devices[:need])
+    if n_replicas > 1:
+        return Mesh(arr.reshape(n_replicas, n_shards),
+                    (REPLICA_AXIS, SHARD_AXIS))
+    return Mesh(arr.reshape(n_shards), (SHARD_AXIS,))
+
+
+@dataclass
+class HostMap:
+    """Topology record: shard count, replication, and key routing.
+
+    The reference's ``hosts.conf`` distilled: ``index-splits:`` →
+    ``n_shards``, ``num-mirrors:`` → ``n_replicas - 1``.
+    """
+
+    n_shards: int
+    n_replicas: int = 1
+    alive: np.ndarray = field(default=None)  # bool [n_shards] (PingServer)
+
+    def __post_init__(self):
+        if self.alive is None:
+            self.alive = np.ones(self.n_shards, dtype=bool)
+
+    def shard_of_docid(self, docid) -> np.ndarray:
+        return posdb.shard_of_docid(docid, self.n_shards)
+
+    def shard_of_keys(self, keys: np.ndarray) -> np.ndarray:
+        return posdb.shard_of_keys(keys, self.n_shards)
+
+    def mark_dead(self, shard: int) -> None:
+        """PingServer dead-host marking (``PingServer.h:61``)."""
+        self.alive[shard] = False
+
+    def mark_alive(self, shard: int) -> None:
+        self.alive[shard] = True

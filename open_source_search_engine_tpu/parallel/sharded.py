@@ -1,0 +1,315 @@
+"""Sharded index + scatter-gather query over a device mesh.
+
+Reference mapping (SURVEY §2.5, §3.2):
+
+* **Document partitioning** — every record routed by docid hash
+  (``Hostdb::getShardNum`` ``Hostdb.cpp:2486``; checksum terms by termid,
+  ``getShardNumByTermId`` ``Hostdb.cpp:2468``) →
+  :class:`ShardedCollection` splits each document's meta list across
+  per-shard Collections with the same hash functions.
+* **Msg3a scatter-gather** — fan Msg39 out to every shard, k-way merge
+  per-shard top-k (``Msg3a.cpp:971``) → one ``shard_map`` over the
+  ``shards`` mesh axis: each device scores its own shard's candidates
+  (the Msg39 intersect, now :func:`..query.scorer.score_core`), then an
+  **in-mesh all-gather top-k merge** replaces the UDP reply + host-side
+  merge — the collective rides ICI, and every shard finishes holding the
+  replicated global top-k.
+* **Msg20 summaries** — per-result titlerec lookups go to the shard
+  owning the docid (``Msg20.cpp:90``) → host-side reads from the owning
+  shard's titledb.
+
+Per-shard packed shapes are padded to the fleet-wide bucket so the
+stacked [S, ...] arrays are rectangular; empty shards ship a zero-valid
+dummy block (the reference's empty Msg39 reply).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..build import docproc
+from ..index import posdb
+from ..index.collection import Collection
+from ..query import weights
+from ..query.compiler import QueryPlan, compile_query
+from ..query.engine import SearchResults, build_results
+from ..query.packer import (MAX_POSITIONS, PackedQuery, PreparedQuery,
+                            _pad1, group_flags, pack_pass, prepare_query)
+from ..query.scorer import score_core
+from ..utils.log import get_logger
+from .hostmap import SHARD_AXIS, HostMap, make_mesh
+
+log = get_logger("parallel")
+
+
+class ShardedCollection:
+    """One logical collection partitioned across N shards.
+
+    Each shard is a full Collection (posdb/titledb/clusterdb) under
+    ``base_dir/shard_XXX/`` — the analog of one gb instance's working dir.
+    """
+
+    def __init__(self, name: str, base_dir: str | Path, n_shards: int,
+                 n_replicas: int = 1):
+        self.name = name
+        self.base_dir = Path(base_dir)
+        self.hostmap = HostMap(n_shards, n_replicas)
+        self.shards = [
+            Collection(name, self.base_dir / f"shard_{s:03d}")
+            for s in range(n_shards)
+        ]
+
+    @property
+    def n_shards(self) -> int:
+        return self.hostmap.n_shards
+
+    @property
+    def num_docs(self) -> int:
+        return sum(c.num_docs for c in self.shards)
+
+    # --- build plane: route records by shard (Msg4 / Msg1 semantics) ---
+
+    def index_document(self, url: str, content: str, *, is_html: bool = True,
+                       siterank: int = 0, langid: int | None = None):
+        """Index one document, scattering its records to owning shards
+        (the reference's Msg4 meta-list add: posdb keys split by docid/
+        termid shard, titledb+clusterdb to the docid's shard)."""
+        self.remove_document(url)
+        ml = docproc.build_meta_list(url, content, is_html=is_html,
+                                     siterank=siterank, langid=langid)
+        home = int(self.hostmap.shard_of_docid(ml.docid))
+        key_shards = self.hostmap.shard_of_keys(ml.posdb_keys)
+        for s in np.unique(key_shards):
+            self.shards[int(s)].posdb.add(ml.posdb_keys[key_shards == s])
+        coll = self.shards[home]
+        coll.titledb.add(ml.titledb_key.reshape(1), [ml.title_rec])
+        coll.clusterdb.add(ml.clusterdb_key.reshape(1))
+        coll.titlerec_cache.pop(ml.docid, None)
+        coll.doc_added()
+        return ml
+
+    def remove_document(self, url: str) -> bool:
+        from ..utils.url import normalize
+        from ..utils import ghash
+        docid = ghash.doc_id(normalize(url).full)
+        home = int(self.hostmap.shard_of_docid(docid))
+        ml = docproc.get_document(self.shards[home], url=url)
+        if ml is None:
+            return False
+        # regenerate tombstones and scatter them the same way
+        dead = docproc.tombstone_meta_list(ml)
+        key_shards = self.hostmap.shard_of_keys(dead.posdb_keys)
+        for s in np.unique(key_shards):
+            self.shards[int(s)].posdb.add(dead.posdb_keys[key_shards == s])
+        coll = self.shards[home]
+        coll.titledb.add(dead.titledb_key.reshape(1), [b""])
+        coll.clusterdb.add(dead.clusterdb_key.reshape(1))
+        coll.titlerec_cache.pop(dead.docid, None)
+        coll.doc_removed()
+        return True
+
+    def get_document(self, docid: int) -> dict | None:
+        """Msg22 titlerec fetch from the owning shard."""
+        home = int(self.hostmap.shard_of_docid(docid))
+        return docproc.get_document(self.shards[home], docid=docid)
+
+    def save(self) -> None:
+        for c in self.shards:
+            c.save()
+
+
+# ---------------------------------------------------------------------------
+# the sharded kernel (Msg39 per shard + Msg3a merge, one program)
+# ---------------------------------------------------------------------------
+
+def _pad_packed(pq: PackedQuery | None, T: int, L: int, D: int,
+                plan: QueryPlan, freqw: np.ndarray) -> PackedQuery:
+    """Pad one shard's pack to the fleet-wide (T, L, D) bucket; ``None``
+    becomes an all-invalid dummy block (empty Msg39 reply)."""
+    if pq is None:
+        required, negative, scored = group_flags(plan, T)
+        return PackedQuery(
+            doc_idx=np.full((T, L), D, np.int32),
+            payload=np.zeros((T, L), np.uint32),
+            slot=np.zeros((T, L), np.int32),
+            valid=np.zeros((T, L), bool),
+            freq_weight=_pad1(freqw, T, 0.5),
+            required=required, negative=negative, scored=scored,
+            cand_docids=np.empty(0, np.uint64),
+            siterank=np.zeros(D, np.int32), doclang=np.zeros(D, np.int32),
+            n_docs=0, qlang=plan.lang)
+    t, l = pq.doc_idx.shape
+    d = len(pq.siterank)
+    doc_idx = np.full((T, L), D, np.int32)
+    # re-point this shard's dump row (== its old D pad) at the new one
+    di = pq.doc_idx.copy()
+    di[di >= d] = D
+    doc_idx[:t, :l] = di
+    payload = np.zeros((T, L), np.uint32)
+    payload[:t, :l] = pq.payload
+    slot = np.zeros((T, L), np.int32)
+    slot[:t, :l] = pq.slot
+    valid = np.zeros((T, L), bool)
+    valid[:t, :l] = pq.valid
+    siterank = np.zeros(D, np.int32)
+    siterank[:d] = pq.siterank
+    doclang = np.zeros(D, np.int32)
+    doclang[:d] = pq.doclang
+    return PackedQuery(
+        doc_idx=doc_idx, payload=payload, slot=slot, valid=valid,
+        freq_weight=_pad1(freqw, T, 0.5),
+        required=pq.required, negative=pq.negative,
+        scored=pq.scored, cand_docids=pq.cand_docids,
+        siterank=siterank, doclang=doclang, n_docs=pq.n_docs,
+        qlang=pq.qlang)
+
+
+@partial(jax.jit, static_argnames=("mesh", "local_k", "out_k",
+                                   "n_positions"))
+def _sharded_score(mesh, doc_idx, payload, slot, valid, freq_weight,
+                   required, negative, scored, siterank, doclang, qlang,
+                   n_docs, local_k: int, out_k: int,
+                   n_positions: int = MAX_POSITIONS):
+    """shard_map program: per-shard intersect+score, in-mesh top-k merge.
+
+    Inputs carry a leading shard axis [S, ...]; outputs are replicated:
+    (total matches, merged scores [out_k], owning shard [out_k],
+    local idx [out_k]). ``local_k`` caps each shard's contribution (≤ its
+    candidate count); the merge then takes the global ``out_k`` best of
+    the S·local_k gathered survivors.
+    """
+    spec = P(SHARD_AXIS)
+    rep = P()
+
+    def per_shard(di, pl, sl, va, fw, rq, ng, sc, sr, dl, ql, nd):
+        n_matched, ts, ti = score_core(
+            di[0], pl[0], sl[0], va[0], fw[0], rq[0], ng[0], sc[0],
+            sr[0], dl[0], ql[0], nd[0],
+            n_positions=n_positions, topk=local_k)
+        k = ts.shape[0]
+        # Msg3a merge as an ICI collective: gather every shard's top-k,
+        # take the global top-k (replicated on all shards)
+        g_sc = jax.lax.all_gather(ts, SHARD_AXIS)        # [S, k]
+        g_ix = jax.lax.all_gather(ti, SHARD_AXIS)        # [S, k]
+        g_nm = jax.lax.all_gather(n_matched, SHARD_AXIS)  # [S]
+        flat = g_sc.reshape(-1)
+        m_sc, m_pos = jax.lax.top_k(flat, min(out_k, flat.shape[0]))
+        m_shard = (m_pos // k).astype(jnp.uint32)
+        m_local = g_ix.reshape(-1)[m_pos].astype(jnp.uint32)
+        # one packed output vector = one host RPC round trip (tunneled
+        # backends charge ~50ms per fetched array): [total, shard…,
+        # local…, bitcast(score)…]
+        return jnp.concatenate([
+            jnp.atleast_1d(jnp.sum(g_nm).astype(jnp.uint32)),
+            m_shard, m_local,
+            jax.lax.bitcast_convert_type(m_sc, jnp.uint32),
+        ])
+
+    return jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(spec,) * 12,
+        out_specs=rep,
+        check_vma=False,
+    )(doc_idx, payload, slot, valid, freq_weight, required, negative,
+      scored, siterank, doclang, qlang, n_docs)
+
+
+def _global_freq_weights(preps: list[PreparedQuery],
+                         plan: QueryPlan, num_docs: int) -> np.ndarray:
+    """Cluster-wide term-frequency weights: per-shard unique-doc counts
+    summed — including shards with no candidates, whose postings still
+    count toward document frequency (the reference ships global
+    termFreqWeights in the Msg39 request, computed at the Msg3a layer)."""
+    counts = sum(p.unique_counts for p in preps)
+    return weights.term_freq_weight(counts, max(num_docs, 1))
+
+
+def sharded_search(sc: ShardedCollection, q: str | QueryPlan, *,
+                   mesh=None, topk: int = 10, lang: int = 0,
+                   with_snippets: bool = True,
+                   site_cluster: bool = True) -> SearchResults:
+    """Scatter-gather query over the mesh (Msg40→Msg3a→Msg39 path)."""
+    plan = q if isinstance(q, QueryPlan) else compile_query(q, lang=lang)
+    if mesh is None:
+        mesh = make_mesh(sc.n_shards)
+
+    preps = [prepare_query(c, plan) for c in sc.shards]
+    freqw = _global_freq_weights(preps, plan, sc.num_docs)
+
+    packs = [pack_pass(p) for p in preps]
+    live = [p for p in packs if p is not None]
+    if not live:
+        return SearchResults(query=plan.raw, total_matches=0)
+    T = max(p.doc_idx.shape[0] for p in live)
+    L = max(p.doc_idx.shape[1] for p in live)
+    D = max(len(p.siterank) for p in live)
+    packs = [_pad_packed(p, T, L, D, plan, freqw) for p in packs]
+
+    k = min(max(topk, 64), D)
+    stack = lambda f: np.stack([f(p) for p in packs])
+    args = dict(
+        doc_idx=stack(lambda p: p.doc_idx),
+        payload=stack(lambda p: p.payload),
+        slot=stack(lambda p: p.slot),
+        valid=stack(lambda p: p.valid),
+        freq_weight=stack(lambda p: p.freq_weight),
+        required=stack(lambda p: p.required),
+        negative=stack(lambda p: p.negative),
+        scored=stack(lambda p: p.scored),
+        siterank=stack(lambda p: p.siterank),
+        doclang=stack(lambda p: p.doclang),
+        qlang=np.full(sc.n_shards, plan.lang, np.int32),
+        n_docs=stack(lambda p: np.int32(p.n_docs)),
+    )
+    # lay the shard axis over the mesh so each device holds its own block
+    sharded_args = {
+        name: jax.device_put(
+            a, NamedSharding(mesh, P(SHARD_AXIS,
+                                     *([None] * (a.ndim - 1)))))
+        for name, a in args.items()
+    }
+    # over-fetch + escalate: if site clustering leaves the page short,
+    # re-merge with a larger out_k (the reference's Msg40 recall loop,
+    # Msg40.cpp:2117, redesigned as k·c over-fetch per SURVEY §7 hard
+    # part (c) — the per-shard scoring is cached, only the merge regrows)
+    out_k = max(topk, 64)
+    max_out = sc.n_shards * k
+    while True:
+        kk = min(out_k, max_out)
+        out = np.asarray(_sharded_score(
+            mesh, sharded_args["doc_idx"], sharded_args["payload"],
+            sharded_args["slot"], sharded_args["valid"],
+            sharded_args["freq_weight"], sharded_args["required"],
+            sharded_args["negative"], sharded_args["scored"],
+            sharded_args["siterank"], sharded_args["doclang"],
+            sharded_args["qlang"], sharded_args["n_docs"],
+            local_k=k, out_k=kk))
+        total = int(out[0])
+        m_shard = out[1:1 + kk].astype(np.int64)
+        m_local = out[1 + kk:1 + 2 * kk].astype(np.int64)
+        m_sc = out[1 + 2 * kk:].view(np.float32).copy()
+
+        # map (owning shard, local candidate idx) → docid; padded-slot
+        # hits score 0 and are filtered inside build_results
+        docids = np.zeros(len(m_sc), np.uint64)
+        for i, (shard, local) in enumerate(zip(m_shard, m_local)):
+            cd = packs[int(shard)].cand_docids
+            if int(local) < len(cd):
+                docids[i] = cd[int(local)]
+            else:
+                m_sc[i] = 0.0
+        results, clustered = build_results(
+            sc.get_document, docids, m_sc, plan, topk=topk,
+            with_snippets=with_snippets, site_cluster=site_cluster)
+        if (len(results) >= topk or clustered == 0 or out_k >= max_out):
+            break
+        out_k *= 4
+    return SearchResults(query=plan.raw, total_matches=int(total),
+                         results=results, clustered=clustered)
