@@ -27,6 +27,11 @@ from .scorer import run_query
 log = get_logger("query")
 
 
+#: site-clustering cap: at most this many results per site
+#: (reference Msg51/Msg40 "site clustering (max 2/site)", Msg51.h:96)
+MAX_PER_SITE = 2
+
+
 @dataclass
 class Result:
     docid: int
@@ -42,71 +47,93 @@ class SearchResults:
     query: str
     total_matches: int
     results: list[Result] = field(default_factory=list)
+    clustered: int = 0  # results hidden by site clustering (Msg51)
 
 
-def _make_snippet(text: str, words: list[str], radius: int = 90) -> str:
-    """Cheap query-biased excerpt: window around the densest match region
-    (the full ``Summary::getBestWindow`` port lands with the Msg20 layer)."""
-    if not text:
-        return ""
-    low = text.lower()
-    hits = [low.find(w) for w in words]
-    hits = [h for h in hits if h >= 0]
-    if not hits:
-        return text[: 2 * radius].strip()
-    center = min(hits)
-    lo = max(0, center - radius)
-    hi = min(len(text), center + radius)
-    out = text[lo:hi].strip()
-    if lo > 0:
-        out = "…" + out
-    if hi < len(text):
-        out += "…"
-    return out
+def build_results(get_doc, docids, scores, plan: QueryPlan, *,
+                  topk: int, with_snippets: bool = True,
+                  site_cluster: bool = True) -> tuple[list[Result], int]:
+    """Msg40's post-merge stage: walk merged candidates best-first, fetch
+    titlerecs from the owning store (Msg20/Msg22), apply site clustering
+    (Msg51: at most MAX_PER_SITE per site, rest hidden), build summaries.
+
+    ``get_doc`` is docid → titlerec dict (routes to the owning shard in
+    the mesh path). Returns (results, number clustered away).
+    """
+    from . import summary as summary_mod
+
+    words = [g.display for g in plan.scored_groups]
+    per_site: dict[str, int] = {}
+    results: list[Result] = []
+    clustered = 0
+    for docid, score in zip(docids, scores):
+        if len(results) >= topk:
+            break
+        if score <= 0.0:
+            continue
+        rec = get_doc(int(docid))
+        r = Result(docid=int(docid), score=float(score))
+        if rec:
+            r.url = rec.get("url", "")
+            r.title = rec.get("title", "")
+            r.site = rec.get("site", "")
+            if site_cluster and r.site:
+                seen = per_site.get(r.site, 0)
+                if seen >= MAX_PER_SITE:
+                    clustered += 1
+                    continue
+                per_site[r.site] = seen + 1
+            if with_snippets:
+                r.snippet = summary_mod.make_summary(
+                    rec.get("text", ""), words)
+        results.append(r)
+    return results, clustered
 
 
 def search(coll: Collection, q: str | QueryPlan, *, topk: int = 10,
            lang: int = 0, max_docs_per_pass: int = 1 << 16,
-           with_snippets: bool = True) -> SearchResults:
+           with_snippets: bool = True,
+           site_cluster: bool = True) -> SearchResults:
     """Execute a query against one collection (single shard)."""
     plan = q if isinstance(q, QueryPlan) else compile_query(q, lang=lang)
     raw = plan.raw
 
-    # docid-range multipass: fetch+intersect once, then score candidate
-    # slices, merging top-k across passes
-    all_docids: list[np.ndarray] = []
-    all_scores: list[np.ndarray] = []
-    total = 0
     prep = prepare_query(coll, plan)
-    if prep is not None:
+
+    # over-fetch + escalate: when site clustering leaves the page short,
+    # re-score with a larger k (the Msg40 recall loop, Msg40.cpp:2117,
+    # as over-fetch per SURVEY §7 hard part (c)); the sharded path has
+    # the same loop around its merge
+    k = max(topk, 64)
+    while True:
+        # docid-range multipass: fetch+intersect once, then score
+        # candidate slices, merging top-k across passes
+        all_docids: list[np.ndarray] = []
+        all_scores: list[np.ndarray] = []
+        total = 0
         for offset in range(0, len(prep.cand), max_docs_per_pass):
             pq = pack_pass(prep, doc_offset=offset,
                            max_docs=max_docs_per_pass)
             if pq is None:
                 break
-            docids, scores, n_matched = run_query(pq, topk=max(topk, 64))
+            docids, scores, n_matched = run_query(pq, topk=k)
             total += n_matched
             all_docids.append(docids)
             all_scores.append(scores)
 
-    if not all_docids:
-        return SearchResults(query=raw, total_matches=0)
-    docids = np.concatenate(all_docids)
-    scores = np.concatenate(all_scores)
-    order = np.argsort(-scores, kind="stable")[:topk]
+        if not all_docids:
+            return SearchResults(query=raw, total_matches=0)
+        docids = np.concatenate(all_docids)
+        scores = np.concatenate(all_scores)
+        order = np.argsort(-scores, kind="stable")
 
-    words = [g.display for g in plan.scored_groups]
-    results = []
-    for i in order:
-        if scores[i] <= 0:
+        results, clustered = build_results(
+            lambda d: docproc.get_document(coll, docid=d),
+            docids[order], scores[order], plan, topk=topk,
+            with_snippets=with_snippets, site_cluster=site_cluster)
+        if (len(results) >= topk or clustered == 0
+                or k >= len(prep.cand)):
             break
-        rec = docproc.get_document(coll, docid=int(docids[i]))
-        r = Result(docid=int(docids[i]), score=float(scores[i]))
-        if rec:
-            r.url = rec.get("url", "")
-            r.title = rec.get("title", "")
-            r.site = rec.get("site", "")
-            if with_snippets:
-                r.snippet = _make_snippet(rec.get("text", ""), words)
-        results.append(r)
-    return SearchResults(query=raw, total_matches=total, results=results)
+        k *= 4
+    return SearchResults(query=raw, total_matches=total, results=results,
+                         clustered=clustered)

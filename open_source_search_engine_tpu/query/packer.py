@@ -55,6 +55,15 @@ def _bucket(n: int, floor: int = 8) -> int:
     return b
 
 
+#: shape-bucket floors. Each distinct (T, L, D) triple is one XLA
+#: compilation (~20-40 s cold on TPU), so floors are set high enough that
+#: everyday queries collapse into a handful of buckets; the wasted lanes
+#: are masked compute the VPU shrugs off.
+T_FLOOR = 4      # term groups
+L_FLOOR = 512    # postings per group
+D_FLOOR = 256    # candidate docs
+
+
 @dataclass
 class PackedQuery:
     """Device-ready query: everything the scorer jit consumes.
@@ -137,6 +146,15 @@ def fetch_group_lists(coll: Collection, plan: QueryPlan) -> list[GroupList]:
     return out
 
 
+def _pad1(a: np.ndarray, n: int, fill) -> np.ndarray:
+    """Pad a 1-D per-group array out to the T bucket."""
+    if len(a) >= n:
+        return a
+    out = np.full(n, fill, dtype=a.dtype)
+    out[: len(a)] = a
+    return out
+
+
 @dataclass
 class PreparedQuery:
     """Fetch+intersect product, computed ONCE per query: multipass slices
@@ -145,28 +163,53 @@ class PreparedQuery:
 
     plan: QueryPlan
     lists: list[GroupList]
-    cand: np.ndarray          # uint64, all candidate docids (sorted)
-    driver: int
-    freq_weight: np.ndarray   # float32 [T]
+    cand: np.ndarray          # uint64, candidate docids (sorted; may be 0)
+    driver: int               # -1 when cand is empty
+    freq_weight: np.ndarray   # float32 [len(plan.groups)]
+    unique_counts: np.ndarray  # int64 [len(plan.groups)] docs per group
 
 
-def prepare_query(coll: Collection, plan: QueryPlan) -> PreparedQuery | None:
+def group_flags(plan: QueryPlan, T: int):
+    """(required, negative, scored) bool arrays padded to the T bucket —
+    pure functions of the plan, shared by every shard/pass."""
+    return (
+        _pad1(np.array([g.required and not g.negative
+                        for g in plan.groups]), T, False),
+        _pad1(np.array([g.negative for g in plan.groups]), T, False),
+        _pad1(np.array([g.scored and not g.negative
+                        for g in plan.groups]), T, False),
+    )
+
+
+def prepare_query(coll: Collection, plan: QueryPlan) -> PreparedQuery:
     """Fetch termlists, pick the driver, intersect candidates.
 
-    Returns None when no doc can match (an empty required list — the
-    reference's early-out when a termlist is empty, ``Msg39.cpp``).
+    ``cand`` comes back empty when no doc can match (an empty required
+    list — the reference's early-out, ``Msg39.cpp``) but the fetched
+    lists are still returned: cluster-wide term-frequency stats must
+    count a shard's postings even when that shard has no candidates.
     """
     lists = fetch_group_lists(coll, plan)
     req = [i for i, g in enumerate(plan.groups)
            if g.required and not g.negative]
-    if not req:
-        return None
-    for i in req:
-        if not len(lists[i].docids):
-            return None  # AND with an empty list matches nothing
+
+    uniques = {i: np.unique(lists[i].docids) for i in req}
+    # per-group unique-doc counts for term-frequency stats (scored ⊆
+    # required, so required groups' counts are the ones that matter)
+    unique_counts = np.array(
+        [len(uniques[i]) if i in uniques else
+         len(np.unique(lists[i].docids)) if len(lists[i].docids) else 0
+         for i in range(len(lists))], dtype=np.int64)
+    nd = max(coll.num_docs, 1)
+    freqw = weights.term_freq_weight(unique_counts, nd)
+
+    if not req or any(not len(uniques[i]) for i in req):
+        return PreparedQuery(plan=plan, lists=lists,
+                             cand=np.empty(0, np.uint64), driver=-1,
+                             freq_weight=freqw,
+                             unique_counts=unique_counts)
 
     # driver = required group with fewest unique docids
-    uniques = {i: np.unique(lists[i].docids) for i in req}
     driver = min(req, key=lambda i: len(uniques[i]))
     cand = uniques[driver]
     # intersect with every other required group's docids (cheap host-side
@@ -174,19 +217,8 @@ def prepare_query(coll: Collection, plan: QueryPlan) -> PreparedQuery | None:
     for i in req:
         if i != driver and len(cand):
             cand = cand[np.isin(cand, uniques[i], assume_unique=True)]
-    if not len(cand):
-        return None
-
-    # term-frequency weights from unique-doc counts (reuse the uniques
-    # already computed for required groups; only scored groups' weights
-    # feed the kernel, and scored ⊆ required)
-    nd = max(coll.num_docs, 1)
-    freqw = np.array(
-        [float(weights.term_freq_weight(len(uniques[i]), nd))
-         if i in uniques else 0.5 for i in range(len(lists))],
-        dtype=np.float32)
     return PreparedQuery(plan=plan, lists=lists, cand=cand, driver=driver,
-                         freq_weight=freqw)
+                         freq_weight=freqw, unique_counts=unique_counts)
 
 
 def pack_pass(prep: PreparedQuery, doc_offset: int = 0,
@@ -201,10 +233,12 @@ def pack_pass(prep: PreparedQuery, doc_offset: int = 0,
         cand = prep.cand[doc_offset:] if doc_offset else prep.cand
     if not len(cand):
         return None
+    required, negative, scored = group_flags(
+        plan, _bucket(len(plan.groups), T_FLOOR))
 
-    T = len(plan.groups)
+    T = _bucket(len(plan.groups), T_FLOOR)
     D = len(cand)
-    D_pad = _bucket(D)
+    D_pad = _bucket(D, D_FLOOR)
 
     per_group = []
     max_kept = 1
@@ -232,7 +266,7 @@ def pack_pass(prep: PreparedQuery, doc_offset: int = 0,
             slot = np.empty(0, np.int32)
         per_group.append((didx, payload, slot))
 
-    L = _bucket(max_kept)
+    L = _bucket(max_kept, L_FLOOR)
     doc_idx = np.full((T, L), D_pad, dtype=np.int32)  # D_pad = drop row
     payload = np.zeros((T, L), dtype=np.uint32)
     slot = np.zeros((T, L), dtype=np.int32)
@@ -255,12 +289,8 @@ def pack_pass(prep: PreparedQuery, doc_offset: int = 0,
 
     return PackedQuery(
         doc_idx=doc_idx, payload=payload, slot=slot, valid=valid,
-        freq_weight=prep.freq_weight,
-        required=np.array([g.required and not g.negative
-                           for g in plan.groups]),
-        negative=np.array([g.negative for g in plan.groups]),
-        scored=np.array([g.scored and not g.negative
-                         for g in plan.groups]),
+        freq_weight=_pad1(prep.freq_weight, T, 0.5),
+        required=required, negative=negative, scored=scored,
         cand_docids=cand,
         siterank=siterank, doclang=doclang,
         n_docs=D, qlang=plan.lang)
@@ -269,8 +299,7 @@ def pack_pass(prep: PreparedQuery, doc_offset: int = 0,
 def pack_query(coll: Collection, plan: QueryPlan,
                doc_offset: int = 0,
                max_docs: int | None = None) -> PackedQuery | None:
-    """One-shot convenience: prepare + pack a single pass."""
-    prep = prepare_query(coll, plan)
-    if prep is None:
-        return None
-    return pack_pass(prep, doc_offset=doc_offset, max_docs=max_docs)
+    """One-shot convenience: prepare + pack a single pass (None when no
+    candidate can match — pack_pass's empty-cand early-out)."""
+    return pack_pass(prepare_query(coll, plan), doc_offset=doc_offset,
+                     max_docs=max_docs)

@@ -36,8 +36,6 @@ two so the jit cache stays small.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -59,11 +57,12 @@ def _decode(payload: jnp.ndarray):
     return wordpos, hg, den, spam, syn
 
 
-@partial(jax.jit, static_argnames=("n_positions", "topk"))
-def score_and_topk(doc_idx, payload, slot, valid, freq_weight, required,
-                   negative, scored, siterank, doclang, qlang, n_docs,
-                   n_positions: int = MAX_POSITIONS, topk: int = 64):
-    """Score every candidate doc and return (top scores, top doc indices).
+def score_core(doc_idx, payload, slot, valid, freq_weight, required,
+               negative, scored, siterank, doclang, qlang, n_docs,
+               n_positions: int = MAX_POSITIONS, topk: int = 64):
+    """Score every candidate doc and return (match count, top scores, top
+    doc indices). Pure traced function — called under plain jit for the
+    single-shard path and inside ``shard_map`` for the mesh path.
 
     Shapes: doc_idx/payload/slot/valid [T, L]; freq_weight/required/
     negative/scored [T]; siterank/doclang [D]; qlang/n_docs scalars.
@@ -174,15 +173,41 @@ def score_and_topk(doc_idx, payload, slot, valid, freq_weight, required,
     return n_matched, top_scores, top_idx
 
 
+score_and_topk = jax.jit(score_core, static_argnames=("n_positions", "topk"))
+
+
+def _score_packed_out(*args, n_positions: int, topk: int):
+    """score_core with the three outputs packed into ONE uint32 vector:
+    ``[n_matched, top_idx…, bitcast(top_scores)…]``. A device→host fetch
+    costs a full RPC round trip on tunneled TPU backends (~50 ms each,
+    not batched by device_get), so one output array = one round trip."""
+    n_matched, ts, ti = score_core(*args, n_positions=n_positions,
+                                   topk=topk)
+    return jnp.concatenate([
+        jnp.atleast_1d(n_matched.astype(jnp.uint32)),
+        ti.astype(jnp.uint32),
+        jax.lax.bitcast_convert_type(ts, jnp.uint32),
+    ])
+
+
+_score_packed = jax.jit(_score_packed_out,
+                        static_argnames=("n_positions", "topk"))
+
+
 def run_query(pq: PackedQuery, topk: int = 64):
     """Host wrapper: PackedQuery → (docids, scores, total matched)."""
-    n_matched, top_scores, top_idx = score_and_topk(
+    k = min(topk, len(pq.siterank))
+    # one batched device_put: per-arg implicit transfers each pay the
+    # tunnel RPC overhead; a single list transfer is ~10× cheaper
+    dev = jax.device_put([
         pq.doc_idx, pq.payload, pq.slot, pq.valid, pq.freq_weight,
         pq.required, pq.negative, pq.scored, pq.siterank, pq.doclang,
-        jnp.int32(pq.qlang), jnp.int32(pq.n_docs),
-        n_positions=MAX_POSITIONS, topk=topk)
-    top_scores = np.asarray(top_scores)
-    top_idx = np.asarray(top_idx)
+        np.int32(pq.qlang), np.int32(pq.n_docs)])
+    out = np.asarray(_score_packed(
+        *dev, n_positions=MAX_POSITIONS, topk=topk))
+    n_matched = int(out[0])
+    top_idx = out[1:1 + k].astype(np.int64)
+    top_scores = out[1 + k:].view(np.float32)
     keep = top_scores > 0.0
     idx = top_idx[keep]
-    return pq.cand_docids[idx], top_scores[keep], int(n_matched)
+    return pq.cand_docids[idx], top_scores[keep], n_matched

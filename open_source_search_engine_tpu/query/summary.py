@@ -1,0 +1,121 @@
+"""Query-biased summaries + highlighting (the Msg20 content plane).
+
+Reference: ``Summary.cpp/h`` — ``Summary::set2`` picks the best excerpt
+windows via ``getBestWindow`` (``Summary.h:194``): score a window of words
+around each query-term match by summing matched terms' weights, favoring
+windows containing *more distinct* query terms, trimmed toward sentence
+boundaries; up to ``maxNumLines`` fragments are concatenated. ``Title.cpp``
+falls back through title sources; ``Highlight.cpp`` wraps matched words.
+``Matches.cpp`` locates term hits in the stored document.
+
+Vectorized rather than pointer-walked: term-match positions become numpy
+masks; window scores come from a convolution over the match indicator.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+_WORD_RE = re.compile(r"\w+", re.UNICODE)
+
+#: window width in words (reference summary excerpt length ~ a sentence)
+WINDOW_WORDS = 24
+#: distinct-term bonus: a window with k distinct query terms scores
+#: k²·this on top of raw match counts (getBestWindow favors diversity)
+DISTINCT_BONUS = 4.0
+
+
+def _tokenize_with_spans(text: str) -> tuple[list[str], list[tuple[int, int]]]:
+    words, spans = [], []
+    for m in _WORD_RE.finditer(text):
+        words.append(m.group(0).lower())
+        spans.append(m.span())
+    return words, spans
+
+
+def make_summary(text: str, query_words: list[str], *,
+                 max_fragments: int = 2, window: int = WINDOW_WORDS,
+                 max_chars: int = 320) -> str:
+    """Pick the best-scoring excerpt windows for these query words."""
+    if not text:
+        return ""
+    qset = {w.lower() for w in query_words if w}
+    if not qset:
+        return text[:max_chars].strip()
+    words, spans = _tokenize_with_spans(text)
+    if not words:
+        return text[:max_chars].strip()
+    n = len(words)
+    warr = np.array(words)
+    hit = np.isin(warr, list(qset))
+    if not hit.any():
+        return text[:max_chars].strip()
+
+    # term ids for distinct-term counting inside windows
+    qlist = sorted(qset)
+    qid = {w: i for i, w in enumerate(qlist)}
+    ids = np.array([qid.get(w, -1) for w in words], dtype=np.int32)
+
+    win = min(window, n)
+    # windowed match count via cumulative sum
+    csum = np.concatenate([[0], np.cumsum(hit)])
+    counts = csum[win:] - csum[:-win]                    # [n-win+1]
+    # distinct terms per window: one-hot over query ids, windowed any()
+    onehot = np.zeros((n, len(qlist)), dtype=np.int32)
+    rows = np.nonzero(ids >= 0)[0]
+    onehot[rows, ids[rows]] = 1
+    oc = np.vstack([np.zeros(len(qlist), np.int32),
+                    np.cumsum(onehot, axis=0)])
+    distinct = ((oc[win:] - oc[:-win]) > 0).sum(axis=1)  # [n-win+1]
+    scores = counts + DISTINCT_BONUS * distinct * distinct
+
+    frags: list[tuple[int, int]] = []  # word-index ranges
+    sc = scores.astype(np.float64).copy()
+    for _ in range(max_fragments):
+        best = int(np.argmax(sc))
+        if sc[best] <= 0:
+            break
+        lo, hi = best, min(best + win, n)
+        frags.append((lo, hi))
+        # suppress overlapping windows for the next fragment
+        s = max(0, best - win + 1)
+        sc[s:best + win] = -1.0
+    frags.sort()
+
+    parts = []
+    used = 0
+    for lo, hi in frags:
+        clo, chi = spans[lo][0], spans[hi - 1][1]
+        # extend to sentence-ish boundaries within a small slack
+        head = text.rfind(". ", max(0, clo - 60), clo)
+        clo2 = head + 2 if head >= 0 else clo
+        tail = text.find(". ", chi, chi + 60)
+        chi2 = tail + 1 if tail >= 0 else chi
+        frag = text[clo2:chi2].strip()
+        if clo2 > 0 and head < 0:
+            frag = "…" + frag
+        if chi2 < len(text) and tail < 0:
+            frag += "…"
+        if used + len(frag) > max_chars and parts:
+            break
+        parts.append(frag)
+        used += len(frag)
+    return " ".join(parts)[: max_chars + 40]
+
+
+def highlight(text: str, query_words: list[str],
+              pre: str = "<b>", post: str = "</b>") -> str:
+    """Wrap query-word matches (``Highlight.cpp`` front-tag/back-tag)."""
+    qset = {w.lower() for w in query_words if w}
+    if not qset:
+        return text
+    out, last = [], 0
+    for m in _WORD_RE.finditer(text):
+        if m.group(0).lower() in qset:
+            out.append(text[last:m.start()])
+            out.append(pre + m.group(0) + post)
+            last = m.end()
+    out.append(text[last:])
+    return "".join(out)

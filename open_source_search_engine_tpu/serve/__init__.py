@@ -1,0 +1,10 @@
+"""HTTP API / UI plane (SURVEY §2 L6): the reference's HttpServer + Pages
+routing + PageResults/PageGet/PageAddUrl endpoints, host-side.
+
+The device mesh never sees HTTP: requests terminate here, queries cross
+into the jitted query plane, results render as JSON/XML/CSV/HTML.
+"""
+
+from .server import SearchHTTPServer, serve
+
+__all__ = ["SearchHTTPServer", "serve"]

@@ -1,0 +1,277 @@
+"""HTTP front end — search, cached pages, injection, admin.
+
+Reference: ``HttpServer.cpp`` (nonblocking HTTP server) + ``Pages.cpp``
+page table routing (``Pages.cpp:44,577``) + per-page handlers:
+``PageResults.cpp`` (SERP in HTML/XML/JSON/CSV, ``PageResults.cpp:274``),
+``PageGet.cpp`` (cached page w/ highlighting), ``PageInject.cpp``/
+``PageAddUrl.cpp`` (content/url injection), ``PageStats``/``PageHosts``
+(admin). Python stdlib threading server — the accept/parse plane is not
+the bottleneck (queries are); a C++ front end can slot in front later
+exactly like the reference's ``gb proxy`` mode.
+
+Endpoints (reference query-string names kept: ``q``, ``n``, ``c``):
+
+* ``GET /search?q=...&n=10&c=main&format=json|xml|html``
+* ``GET /get?d=<docid>&q=...`` — cached page, query terms highlighted
+* ``GET|POST /inject?u=<url>`` (body = content) — index a document
+* ``GET /addurl?u=<url>`` — queue a url for the spider
+* ``GET /admin/stats`` — counters; ``GET /admin/hosts`` — shard map
+* ``GET /`` — minimal search form
+"""
+
+from __future__ import annotations
+
+import html as html_mod
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..index.collection import CollectionDb
+from ..query import engine
+from ..query.summary import highlight
+from ..utils.log import get_logger
+
+log = get_logger("http")
+
+
+def _xml_escape(s: str) -> str:
+    return html_mod.escape(s, quote=True)
+
+
+def render_results(res: engine.SearchResults, fmt: str) -> tuple[str, str]:
+    """SERP rendering (PageResults.cpp HTML/XML/JSON/CSV)."""
+    if fmt == "json":
+        return json.dumps({
+            "query": res.query,
+            "totalMatches": res.total_matches,
+            "clustered": res.clustered,
+            "results": [
+                {"docId": r.docid, "score": r.score, "url": r.url,
+                 "title": r.title, "snippet": r.snippet, "site": r.site}
+                for r in res.results
+            ],
+        }), "application/json"
+    if fmt == "xml":
+        rows = "".join(
+            f"<result><docId>{r.docid}</docId>"
+            f"<score>{r.score}</score>"
+            f"<url>{_xml_escape(r.url)}</url>"
+            f"<title>{_xml_escape(r.title)}</title>"
+            f"<snippet>{_xml_escape(r.snippet)}</snippet></result>"
+            for r in res.results)
+        return (f'<?xml version="1.0" encoding="UTF-8"?>'
+                f"<response><query>{_xml_escape(res.query)}</query>"
+                f"<totalMatches>{res.total_matches}</totalMatches>"
+                f"{rows}</response>", "text/xml")
+    if fmt == "csv":
+        lines = ["docid,score,url,title"]
+        for r in res.results:
+            t = r.title.replace('"', '""')
+            lines.append(f'{r.docid},{r.score},"{r.url}","{t}"')
+        return "\n".join(lines), "text/csv"
+    # html
+    items = "".join(
+        f'<li><a href="{html_mod.escape(r.url)}">'
+        f"{html_mod.escape(r.title) or html_mod.escape(r.url)}</a>"
+        f"<br><small>{html_mod.escape(r.snippet)}</small>"
+        f"<br><code>{html_mod.escape(r.url)}</code> "
+        f"<i>{r.score:.1f}</i></li>"
+        for r in res.results)
+    return (f"<html><head><title>{html_mod.escape(res.query)} - search"
+            f"</title></head><body>"
+            f'<form action="/search"><input name="q" '
+            f'value="{html_mod.escape(res.query)}"><input type="submit" '
+            f'value="search"></form>'
+            f"<p>{res.total_matches} matches</p><ol>{items}</ol>"
+            f"</body></html>", "text/html")
+
+
+class SearchHTTPServer:
+    """Owns the collections + (optionally) a sharded index and serves the
+    reference's public endpoints."""
+
+    def __init__(self, base_dir, host: str = "127.0.0.1", port: int = 8000,
+                 sharded=None, spider=None):
+        self.colldb = CollectionDb(base_dir)
+        self.sharded = sharded  # ShardedCollection | None
+        self.spider = spider    # spider queue hook (addurl)
+        self.host = host
+        self.port = port
+        self.stats = {"queries": 0, "injects": 0, "addurls": 0,
+                      "gets": 0, "errors": 0}
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        # the Rdb/MemTable/caches are single-writer structures (the
+        # reference's whole core is single-threaded event-driven,
+        # SURVEY §1); the threaded accept plane serializes at this lock
+        self._lock = threading.RLock()
+
+    # --- request handling -------------------------------------------------
+
+    def handle(self, method: str, path: str, query: dict,
+               body: bytes) -> tuple[int, str, str]:
+        """Route one request → (status, payload, content_type).
+        The Pages.cpp s_pages[] table, as a method."""
+        try:
+            if path == "/":
+                return 200, self._page_root(), "text/html"
+            with self._lock:
+                return self._route(method, path, query, body)
+        except Exception as e:  # noqa: BLE001 — server must not die
+            self.stats["errors"] += 1
+            log.warning("error handling %s: %s", path, e)
+            return 500, json.dumps({"error": str(e)}), "application/json"
+
+    def _route(self, method: str, path: str, query: dict,
+               body: bytes) -> tuple[int, str, str]:
+        if path == "/search":
+            return self._page_search(query)
+        if path == "/get":
+            return self._page_get(query)
+        if path == "/inject":
+            return self._page_inject(query, body)
+        if path == "/addurl":
+            return self._page_addurl(query)
+        if path == "/admin/stats":
+            return 200, json.dumps(self.stats), "application/json"
+        if path == "/admin/hosts":
+            return 200, self._page_hosts(), "application/json"
+        return 404, json.dumps({"error": "no such page"}), \
+            "application/json"
+
+    def _coll(self, query: dict):
+        return self.colldb.get(query.get("c", "main"))
+
+    def _page_root(self) -> str:
+        return ('<html><body><form action="/search">'
+                '<input name="q"><input type="submit" value="search">'
+                "</form></body></html>")
+
+    def _page_search(self, query: dict) -> tuple[int, str, str]:
+        q = query.get("q", "")
+        if not q:
+            return 400, json.dumps({"error": "missing q"}), \
+                "application/json"
+        n = min(int(query.get("n", 10)), 100)
+        fmt = query.get("format", "json")
+        self.stats["queries"] += 1
+        if self.sharded is not None:
+            from ..parallel import sharded_search
+            res = sharded_search(self.sharded, q, topk=n)
+        else:
+            res = engine.search(self._coll(query), q, topk=n)
+        payload, ctype = render_results(res, fmt)
+        return 200, payload, ctype
+
+    def _page_get(self, query: dict) -> tuple[int, str, str]:
+        """Cached page w/ optional highlight (PageGet.cpp)."""
+        from ..build import docproc
+        docid = int(query.get("d", "0"))
+        self.stats["gets"] += 1
+        if self.sharded is not None:
+            rec = self.sharded.get_document(docid)
+        else:
+            rec = docproc.get_document(self._coll(query), docid=docid)
+        if rec is None:
+            return 404, json.dumps({"error": "not found"}), \
+                "application/json"
+        content = rec.get("content", rec.get("text", ""))
+        terms = [w for w in query.get("q", "").split() if w]
+        if terms:
+            content = highlight(content, terms,
+                                pre='<span style="background:yellow">',
+                                post="</span>")
+        return 200, content, "text/html"
+
+    def _page_inject(self, query: dict, body: bytes) -> tuple[int, str, str]:
+        """Direct content injection (PageInject.cpp / msgtype 0x07)."""
+        from ..build import docproc
+        url = query.get("u") or query.get("url")
+        if not url:
+            return 400, json.dumps({"error": "missing u"}), \
+                "application/json"
+        content = body.decode("utf-8", "replace") if body else \
+            query.get("content", "")
+        self.stats["injects"] += 1
+        if self.sharded is not None:
+            ml = self.sharded.index_document(url, content)
+        else:
+            ml = docproc.index_document(self._coll(query), url, content)
+        return 200, json.dumps({"docId": ml.docid,
+                                "numKeys": len(ml.posdb_keys)}), \
+            "application/json"
+
+    def _page_addurl(self, query: dict) -> tuple[int, str, str]:
+        """Queue a url for spidering (PageAddUrl.cpp)."""
+        url = query.get("u") or query.get("url")
+        if not url:
+            return 400, json.dumps({"error": "missing u"}), \
+                "application/json"
+        self.stats["addurls"] += 1
+        if self.spider is None:
+            return 503, json.dumps({"error": "spider not running"}), \
+                "application/json"
+        self.spider.add_url(url)
+        return 200, json.dumps({"queued": url}), "application/json"
+
+    def _page_hosts(self) -> str:
+        """Shard/cluster map (PageHosts.cpp)."""
+        if self.sharded is None:
+            return json.dumps({"shards": 1, "mode": "single"})
+        hm = self.sharded.hostmap
+        return json.dumps({
+            "shards": hm.n_shards,
+            "replicas": hm.n_replicas,
+            "alive": hm.alive.tolist(),
+            "docsPerShard": [c.num_docs for c in self.sharded.shards],
+        })
+
+    # --- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route to our logger
+                log.debug("%s " + fmt, self.client_address[0], *args)
+
+            def _serve(self, method: str):
+                parsed = urllib.parse.urlsplit(self.path)
+                query = dict(urllib.parse.parse_qsl(parsed.query))
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                status, payload, ctype = outer.handle(
+                    method, parsed.path, query, body)
+                data = payload.encode("utf-8")
+                self.send_response(status)
+                self.send_header("Content-Type", ctype + "; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._serve("GET")
+
+            def do_POST(self):
+                self._serve("POST")
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]  # resolve port 0
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        log.info("http server on %s:%d", self.host, self.port)
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+def serve(base_dir, host: str = "127.0.0.1", port: int = 8000,
+          sharded=None) -> SearchHTTPServer:
+    s = SearchHTTPServer(base_dir, host, port, sharded=sharded)
+    s.start()
+    return s

@@ -1,0 +1,91 @@
+"""HTTP API tests — the qainject pattern over the real HTTP boundary
+(reference ``qa.cpp:659`` injects + queries through the live server)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from open_source_search_engine_tpu.serve import serve
+
+DOC = ("<html><head><title>Solar panels guide</title></head><body>"
+       "<p>Solar panels convert sunlight into electricity. Panel "
+       "efficiency varies by cell type.</p></body></html>")
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    s = serve(tmp_path_factory.mktemp("serve"), port=0)
+    yield s
+    s.stop()
+
+
+def _get(server, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}{path}") as r:
+        return r.status, r.read().decode(), r.headers.get_content_type()
+
+
+def _post(server, path, body: bytes):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}", data=body)
+    with urllib.request.urlopen(req) as r:
+        return r.status, r.read().decode()
+
+
+class TestHttpApi:
+    def test_root_form(self, server):
+        status, body, ctype = _get(server, "/")
+        assert status == 200 and "form" in body
+
+    def test_inject_then_search_json(self, server):
+        status, body = _post(
+            server, "/inject?u=http://solar.example.com/guide",
+            DOC.encode())
+        assert status == 200
+        assert json.loads(body)["numKeys"] > 0
+
+        status, body, ctype = _get(server, "/search?q=sunlight")
+        assert status == 200 and ctype == "application/json"
+        res = json.loads(body)
+        assert res["totalMatches"] == 1
+        assert res["results"][0]["url"] == "http://solar.example.com/guide"
+        assert res["results"][0]["title"] == "Solar panels guide"
+
+    def test_search_formats(self, server):
+        for fmt, ctype, marker in (
+                ("xml", "text/xml", "<response>"),
+                ("csv", "text/csv", "docid,score,url,title"),
+                ("html", "text/html", "<ol>")):
+            status, body, ct = _get(server,
+                                    f"/search?q=solar&format={fmt}")
+            assert status == 200 and ct == ctype and marker in body, fmt
+
+    def test_cached_page_with_highlight(self, server):
+        _, body, _ = _get(server, "/search?q=sunlight")
+        docid = json.loads(body)["results"][0]["docId"]
+        status, page, _ = _get(server, f"/get?d={docid}&q=sunlight")
+        assert status == 200
+        assert 'background:yellow">sunlight</span>' in page
+
+    def test_missing_query_is_400(self, server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(server, "/search")
+        assert e.value.code == 400
+
+    def test_unknown_page_is_404(self, server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(server, "/nope")
+        assert e.value.code == 404
+
+    def test_admin_stats_and_hosts(self, server):
+        status, body, _ = _get(server, "/admin/stats")
+        stats = json.loads(body)
+        assert status == 200 and stats["queries"] >= 1
+        status, body, _ = _get(server, "/admin/hosts")
+        assert json.loads(body)["shards"] == 1
+
+    def test_addurl_without_spider_is_503(self, server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(server, "/addurl?u=http://x.example.com/")
+        assert e.value.code == 503

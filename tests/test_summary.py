@@ -1,0 +1,76 @@
+"""Summary/highlight/site-clustering tests (Msg20 + Msg51 equivalents)."""
+
+import pytest
+
+from open_source_search_engine_tpu.build import docproc
+from open_source_search_engine_tpu.index.collection import Collection
+from open_source_search_engine_tpu.query import engine
+from open_source_search_engine_tpu.query.summary import highlight, make_summary
+
+LONG_TEXT = (
+    "The city library opened in 1901. It holds many rare manuscripts. "
+    "Among its collections, the astronomy archive is famous worldwide. "
+    "Visitors can view telescope drawings from the 17th century. "
+    "The archive reading room requires an appointment. "
+    "A separate wing houses modern science journals. "
+    "Children's books occupy the ground floor near the entrance. "
+    "The library garden hosts readings every summer evening."
+)
+
+
+class TestSummary:
+    def test_window_contains_query_terms(self):
+        s = make_summary(LONG_TEXT, ["telescope", "drawings"])
+        assert "telescope" in s.lower()
+        assert "drawings" in s.lower()
+
+    def test_prefers_window_with_more_distinct_terms(self):
+        # 'archive' appears twice; the window with archive AND appointment
+        # must win over the one with archive alone
+        s = make_summary(LONG_TEXT, ["archive", "appointment"],
+                         max_fragments=1)
+        assert "appointment" in s.lower()
+
+    def test_no_match_falls_back_to_head(self):
+        s = make_summary(LONG_TEXT, ["zeppelin"])
+        assert s.startswith("The city library")
+
+    def test_empty_text(self):
+        assert make_summary("", ["x"]) == ""
+
+    def test_highlight_wraps_matches(self):
+        h = highlight("The Cat and the cat.", ["cat"])
+        assert h == "The <b>Cat</b> and the <b>cat</b>."
+
+    def test_highlight_no_query(self):
+        assert highlight("text", []) == "text"
+
+
+class TestSiteClustering:
+    @pytest.fixture(scope="class")
+    def coll(self, tmp_path_factory):
+        c = Collection("cluster", tmp_path_factory.mktemp("cluster"))
+        # 5 docs from one site, 1 from another — all matching 'widget'
+        for i in range(5):
+            docproc.index_document(
+                c, f"http://bigsite.example.com/p{i}",
+                f"<html><title>Widget page {i}</title><body>"
+                f"<p>widget catalog entry number {i} here</p></body></html>")
+        docproc.index_document(
+            c, "http://small.example.org/only",
+            "<html><title>Widget source</title><body>"
+            "<p>widget specialists</p></body></html>")
+        return c
+
+    def test_max_two_per_site(self, coll):
+        res = engine.search(coll, "widget", topk=10)
+        sites = [r.site for r in res.results]
+        assert sites.count("bigsite.example.com") == 2
+        assert sites.count("small.example.org") == 1
+        assert res.clustered == 3  # 3 bigsite results hidden
+        assert res.total_matches == 6  # pre-clustering count
+
+    def test_clustering_can_be_disabled(self, coll):
+        res = engine.search(coll, "widget", topk=10, site_cluster=False)
+        assert len(res.results) == 6
+        assert res.clustered == 0
