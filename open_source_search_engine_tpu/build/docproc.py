@@ -213,10 +213,24 @@ def index_document(coll: Collection, url: str, content: str, *,
     coll.posdb.add(ml.posdb_keys)
     coll.titledb.add(ml.titledb_key.reshape(1), [ml.title_rec])
     coll.clusterdb.add(ml.clusterdb_key.reshape(1))
+    coll.titlerec_cache.pop(ml.docid, None)
     if not old:
         coll.doc_added()
     log.debug("indexed %s docid=%d keys=%d", url, ml.docid, len(ml.posdb_keys))
     return ml
+
+
+def tombstone_meta_list(rec: dict) -> MetaList:
+    """Regenerate a stored document's records as tombstones (the
+    reference's delete/reindex path rebuilds the OLD doc's meta list with
+    negative keys, ``XmlDoc::getMetaList`` del path). Shared by the
+    single-shard and sharded delete flows so the regeneration contract
+    lives in one place."""
+    return build_meta_list(rec["url"], rec.get("content", rec["text"]),
+                           is_html=rec.get("is_html", True),
+                           siterank=rec.get("siterank", 0),
+                           langid=rec.get("langid"), delete=True,
+                           ts=rec.get("ts"))
 
 
 def remove_document(coll: Collection, url: str, _count: bool = True) -> bool:
@@ -236,14 +250,11 @@ def remove_document(coll: Collection, url: str, _count: bool = True) -> bool:
     if not len(match):
         return False
     rec = titledb.read_title_rec(existing.payload(int(match[-1])))
-    ml = build_meta_list(rec["url"], rec.get("content", rec["text"]),
-                         is_html=rec.get("is_html", True),
-                         siterank=rec.get("siterank", 0),
-                         langid=rec.get("langid"), delete=True,
-                         ts=rec.get("ts"))
+    ml = tombstone_meta_list(rec)
     coll.posdb.add(ml.posdb_keys)
     coll.titledb.add(ml.titledb_key.reshape(1), [b""])
     coll.clusterdb.add(ml.clusterdb_key.reshape(1))
+    coll.titlerec_cache.pop(ml.docid, None)
     if _count:
         coll.doc_removed()
     return True
@@ -252,22 +263,31 @@ def remove_document(coll: Collection, url: str, _count: bool = True) -> bool:
 def get_document(coll: Collection, url: str | None = None,
                  docid: int | None = None) -> dict | None:
     """TitleRec lookup by url or docid (reference Msg22 titlerec fetch +
-    PageGet cached-page view)."""
+    PageGet cached-page view), behind the collection's RdbCache-style
+    parsed-rec cache."""
     want = None
     if docid is None:
         assert url is not None
         full = normalize(url).full
         docid = ghash.doc_id(full)
         want = titledb.urlhash32(full)
+    elif docid in coll.titlerec_cache:
+        return coll.titlerec_cache[docid]
     lst = coll.titledb.get_list(titledb.start_key(docid),
                                 titledb.end_key(docid))
-    if not len(lst):
-        return None
-    idx = len(lst) - 1
-    if want is not None:  # docid-collision discrimination
-        match = np.nonzero(
-            titledb.unpack_key(lst.keys)["urlhash32"] == np.uint64(want))[0]
-        if not len(match):
-            return None
-        idx = int(match[-1])
-    return titledb.read_title_rec(lst.payload(idx))
+    rec = None
+    if len(lst):
+        idx = len(lst) - 1
+        if want is not None:  # docid-collision discrimination
+            match = np.nonzero(
+                titledb.unpack_key(lst.keys)["urlhash32"]
+                == np.uint64(want))[0]
+            idx = int(match[-1]) if len(match) else -1
+        if idx >= 0:
+            payload = lst.payload(idx)
+            rec = titledb.read_title_rec(payload) if payload else None
+    if want is None:  # only docid-keyed lookups are cacheable
+        if len(coll.titlerec_cache) >= coll.titlerec_cache_max:
+            coll.titlerec_cache.clear()
+        coll.titlerec_cache[docid] = rec
+    return rec

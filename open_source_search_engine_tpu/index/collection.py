@@ -34,6 +34,11 @@ class Collection:
         self._stats_path = self.dir / "collstats.json"
         self.num_docs = 0
         self._load_stats()
+        #: parsed-titlerec cache keyed by docid (the reference keeps a
+        #: dedicated RdbCache in front of titledb for Msg22 lookups,
+        #: ``RdbCache.h:50``); bounded, dropped wholesale when full
+        self.titlerec_cache: dict[int, dict | None] = {}
+        self.titlerec_cache_max = 16384
 
     # --- stats used by ranking ---
 

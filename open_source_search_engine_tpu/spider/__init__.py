@@ -1,0 +1,17 @@
+"""Build plane: crawler — scheduler, fetcher, link graph, crawl loop.
+
+The reference's Spider/Msg13/Linkdb subsystem (SURVEY §2.6) redesigned
+host-side: the scheduler owns the frontier + politeness (spiderdb/doledb),
+the fetcher downloads with robots awareness (Msg13), linkdb accumulates
+the link graph feeding siterank, and SpiderLoop ties them to the indexer.
+"""
+
+from .fetcher import Fetcher, FetchResult, RobotsCache
+from .linkdb import Linkdb, site_rank
+from .loop import CrawlStats, SpiderLoop
+from .scheduler import SpiderScheduler, UrlFilterRule
+
+__all__ = [
+    "Fetcher", "FetchResult", "RobotsCache", "Linkdb", "site_rank",
+    "CrawlStats", "SpiderLoop", "SpiderScheduler", "UrlFilterRule",
+]

@@ -1,0 +1,127 @@
+"""Fetcher — robots-aware HTTP download service (the Msg13 equivalent).
+
+Reference: ``Msg13.{h,cpp}`` — the "download a url" service: robots.txt
+fetch + cache (``s_hammerCache`` ``Msg13.h:210``), gzip, per-IP hammer
+queue (politeness lives in the scheduler here), response caching, and
+``HttpServer::getDoc`` as the raw client. Proxy routing (SpiderProxy) and
+DNS (``Dns.cpp`` full recursive resolver) ride the OS resolver for now —
+both are isolated behind this interface.
+
+Thread-pool blocking IO instead of the reference's callback chains: the
+fetch plane is embarrassingly parallel and nowhere near the query plane's
+performance envelope.
+"""
+
+from __future__ import annotations
+
+import urllib.error
+import urllib.parse
+import urllib.request
+import urllib.robotparser
+import zlib
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+
+from ..utils.log import get_logger
+
+log = get_logger("fetch")
+
+USER_AGENT = "osse-tpu-bot/0.1"
+MAX_DOC_BYTES = 2 << 20  # cap like the reference's maxTextDocLen
+
+
+@dataclass
+class FetchResult:
+    url: str
+    status: int            # HTTP status; 0 = network error; 999 = robots
+    content: str = ""
+    content_type: str = ""
+    error: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return 200 <= self.status < 300
+
+    @property
+    def is_html(self) -> bool:
+        return "html" in self.content_type or self.content_type == ""
+
+
+class RobotsCache:
+    """robots.txt fetch + parse cache (Msg13's robots cache)."""
+
+    def __init__(self, fetch_fn=None):
+        self._cache: dict[str, urllib.robotparser.RobotFileParser] = {}
+        self._fetch_fn = fetch_fn  # injectable for tests
+
+    def allowed(self, url: str) -> bool:
+        parts = urllib.parse.urlsplit(url)
+        origin = f"{parts.scheme}://{parts.netloc}"
+        rp = self._cache.get(origin)
+        if rp is None:
+            rp = urllib.robotparser.RobotFileParser()
+            try:
+                raw = (self._fetch_fn(origin + "/robots.txt")
+                       if self._fetch_fn else
+                       _raw_get(origin + "/robots.txt"))
+                rp.parse(raw.splitlines())
+            except Exception:
+                rp.parse([])  # unreachable robots.txt = allow all
+            self._cache[origin] = rp
+        return rp.can_fetch(USER_AGENT, url)
+
+
+def _gunzip_capped(data: bytes) -> bytes:
+    """Decompress at most MAX_DOC_BYTES of output — a gzip bomb must not
+    defeat the download cap (the reference likewise bounds doc length
+    after its gbuncompress)."""
+    return zlib.decompressobj(wbits=47).decompress(data, MAX_DOC_BYTES)
+
+
+def _raw_get(url: str, timeout: float = 10.0) -> str:
+    req = urllib.request.Request(url, headers={
+        "User-Agent": USER_AGENT, "Accept-Encoding": "gzip"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        data = r.read(MAX_DOC_BYTES)
+        if r.headers.get("Content-Encoding") == "gzip":
+            data = _gunzip_capped(data)
+        return data.decode(
+            r.headers.get_content_charset() or "utf-8", "replace")
+
+
+class Fetcher:
+    """Parallel robots-aware downloader."""
+
+    def __init__(self, n_threads: int = 8, timeout: float = 10.0,
+                 respect_robots: bool = True):
+        self.pool = ThreadPoolExecutor(max_workers=n_threads,
+                                       thread_name_prefix="fetch")
+        self.timeout = timeout
+        self.respect_robots = respect_robots
+        self.robots = RobotsCache()
+
+    def fetch_one(self, url: str) -> FetchResult:
+        if self.respect_robots and not self.robots.allowed(url):
+            return FetchResult(url=url, status=999, error="robots.txt")
+        req = urllib.request.Request(url, headers={
+            "User-Agent": USER_AGENT, "Accept-Encoding": "gzip"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                data = r.read(MAX_DOC_BYTES)
+                if r.headers.get("Content-Encoding") == "gzip":
+                    data = _gunzip_capped(data)
+                charset = r.headers.get_content_charset() or "utf-8"
+                return FetchResult(
+                    url=r.url, status=r.status,
+                    content=data.decode(charset, "replace"),
+                    content_type=r.headers.get_content_type())
+        except urllib.error.HTTPError as e:
+            return FetchResult(url=url, status=e.code, error=str(e))
+        except Exception as e:  # noqa: BLE001 — network errors are data
+            return FetchResult(url=url, status=0, error=str(e))
+
+    def fetch_many(self, urls: list[str]) -> list[FetchResult]:
+        return list(self.pool.map(self.fetch_one, urls))
+
+    def close(self) -> None:
+        self.pool.shutdown(wait=False, cancel_futures=True)

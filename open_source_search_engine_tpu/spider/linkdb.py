@@ -1,0 +1,86 @@
+"""Linkdb — the link graph store feeding siteNumInlinks/siterank.
+
+Reference: ``Linkdb.{h,cpp}`` — inlink records keyed by linkee site/url
+hash (``Linkdb.h:166``), harvested at index time, aggregated by Msg25
+into LinkInfo whose ``m_numGoodInlinks`` drives the site quality rank via
+``getSiteRank(sni)`` (``Linkdb.cpp:7110`` — a step table, reproduced in
+:func:`site_rank`). Link-text itself rides into posdb as
+HASHGROUP_INLINKTEXT postings during the linker's indexing.
+
+Keys here: (linkee site hash 32, linker site hash 32, linker url hash 32)
+dataless — one record per (linking page → linked site) edge; distinct
+linker-site count = "good inlinks" (the reference dedups inlinks per
+linking site/IP the same way).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..index import rdblite
+from ..utils import ghash
+
+KEY_DTYPE = np.dtype([("n0", "<u4"), ("n1", "<u8")], align=False)
+# n1 = linkee_sitehash32 << 32 | linker_sitehash32 ; n0 = linkerurl31 | delbit
+
+
+def pack_key(linkee_site: str, linker_site: str, linker_url: str,
+             delbit: int = 1) -> np.ndarray:
+    n1 = ((ghash.hash64(linkee_site) & 0xFFFFFFFF) << 32) \
+        | (ghash.hash64(linker_site) & 0xFFFFFFFF)
+    n0 = ((ghash.hash64(linker_url) & 0x7FFFFFFF) << 1) | (delbit & 1)
+    k = np.zeros((), dtype=KEY_DTYPE)
+    k["n1"] = np.uint64(n1)
+    k["n0"] = np.uint32(n0)
+    return k
+
+
+def _site_range(linkee_site: str) -> tuple[np.ndarray, np.ndarray]:
+    h = ghash.hash64(linkee_site) & 0xFFFFFFFF
+    lo = np.zeros((), dtype=KEY_DTYPE)
+    lo["n1"] = np.uint64(h << 32)
+    hi = np.zeros((), dtype=KEY_DTYPE)
+    hi["n1"] = np.uint64((h << 32) | 0xFFFFFFFF)
+    hi["n0"] = np.uint32(0xFFFFFFFF)
+    return lo, hi
+
+
+class Linkdb:
+    """Per-node link graph database (an Rdb instance like the others)."""
+
+    def __init__(self, directory):
+        self.rdb = rdblite.Rdb("linkdb", directory, KEY_DTYPE)
+
+    def add_link(self, linkee_site: str, linker_site: str,
+                 linker_url: str) -> None:
+        if linkee_site == linker_site:
+            return  # internal links don't count toward site quality
+        self.rdb.add(pack_key(linkee_site, linker_site,
+                              linker_url).reshape(1))
+
+    def site_num_inlinks(self, site: str) -> int:
+        """Distinct linking sites (the 'good inlinks' count Msg25 yields)."""
+        lo, hi = _site_range(site)
+        batch = self.rdb.get_list(lo, hi)
+        if not len(batch):
+            return 0
+        linker_sites = np.asarray(batch.keys["n1"]) & np.uint64(0xFFFFFFFF)
+        return int(len(np.unique(linker_sites)))
+
+    def save(self) -> None:
+        self.rdb.save()
+
+
+#: siteNumInlinks → siterank step table (Linkdb.cpp:7110-7128)
+_SITE_RANK_STEPS = [
+    (0, 0), (1, 1), (2, 2), (3, 3), (4, 4), (5, 5), (9, 6), (19, 7),
+    (39, 8), (79, 9), (199, 10), (499, 11), (1999, 12), (4999, 13),
+    (9999, 14),
+]
+
+
+def site_rank(site_num_inlinks: int) -> int:
+    for cap, rank in _SITE_RANK_STEPS:
+        if site_num_inlinks <= cap:
+            return rank
+    return 15

@@ -1,0 +1,154 @@
+"""Spider scheduler — url frontier with filters, priorities, politeness.
+
+Reference: the Spider control plane (``Spider.{h,cpp}``, SURVEY §2.6):
+SpiderRequests live in **spiderdb** keyed by (firstIP, urlhash) so one
+host owns all of an IP's urls; a waiting tree + per-IP politeness waits
+feed **doledb**, the per-priority ready queue drained by SpiderLoop
+(``SpiderLoop::spiderDoledUrls`` ``Spider.cpp:6758``); per-collection
+**url filter rules** map url patterns → priority / frequency / maxhops
+(Collectiondb url filter rows). Duplicate suppression via prior
+SpiderReplies.
+
+Host-side redesign: one scheduler object per node holding (a) `seen`
+(urlhash set = spiderdb replies), (b) per-host ready times (the per-IP
+hammer/politeness map of Msg13), (c) a priority heap (doledb). The
+distributed version shards this by firstIP exactly like the reference —
+the ShardedCollection routes whole-document adds; url routing rides the
+same HostMap.
+"""
+
+from __future__ import annotations
+
+import heapq
+import re
+import time
+from dataclasses import dataclass, field
+
+from ..utils import ghash
+from ..utils.url import normalize
+
+
+@dataclass
+class UrlFilterRule:
+    """One url-filter row (reference per-coll url filter table)."""
+
+    pattern: str                  # substring or regex:... on the full url
+    priority: int = 0             # higher = sooner
+    max_hops: int | None = None   # override crawl depth
+    allow: bool = True            # False = never spider
+    delay_s: float = 0.25         # per-host politeness for matching urls
+    _re: re.Pattern | None = None
+
+    def matches(self, url: str) -> bool:
+        if self.pattern == "*":
+            return True
+        if self.pattern.startswith("regex:"):
+            if self._re is None:
+                self._re = re.compile(self.pattern[6:])
+            return bool(self._re.search(url))
+        return self.pattern in url
+
+
+DEFAULT_FILTERS = [UrlFilterRule("*", priority=0)]
+
+
+@dataclass(order=True)
+class _Doled:
+    sort_key: tuple
+    url: str = field(compare=False)
+    hopcount: int = field(compare=False)
+    priority: int = field(compare=False)
+
+
+@dataclass
+class SpiderRequest:
+    url: str
+    hopcount: int = 0
+    priority: int = 0
+    added: float = 0.0
+
+
+class SpiderScheduler:
+    """Frontier + politeness + dedup (spiderdb/doledb/waiting-tree)."""
+
+    def __init__(self, filters: list[UrlFilterRule] | None = None,
+                 max_hops: int = 3, same_host_only: bool = False):
+        self.filters = filters or list(DEFAULT_FILTERS)
+        self.max_hops = max_hops
+        self.same_host_only = same_host_only
+        self.seen: set[int] = set()          # urlhash48 (spider replies)
+        self.heap: list[_Doled] = []         # doledb
+        self.host_ready_at: dict[str, float] = {}  # per-host politeness
+        self.host_delay: dict[str, float] = {}
+        self.roots: set[str] = set()         # seed hosts for same_host_only
+        self.n_added = 0
+        self.n_doled = 0
+
+    # --- adds (spiderdb writes) ---
+
+    def add_url(self, url: str, hopcount: int = 0) -> bool:
+        """Queue a url if filters allow and it hasn't been seen
+        (``SpiderRequest`` add → waiting tree)."""
+        try:
+            u = normalize(url)
+        except Exception:
+            return False
+        if u.scheme not in ("http", "https"):
+            return False
+        h = ghash.hash64(u.full)
+        if h in self.seen:
+            return False
+        rule = self._rule_for(u.full)
+        if rule is None or not rule.allow:
+            return False
+        cap = rule.max_hops if rule.max_hops is not None else self.max_hops
+        if hopcount > cap:
+            return False
+        if self.same_host_only and self.roots and u.host not in self.roots:
+            return False
+        if hopcount == 0:
+            self.roots.add(u.host)
+        self.seen.add(h)
+        self.host_delay.setdefault(u.host, rule.delay_s)
+        # lower sort key pops first: (-priority, hopcount, arrival)
+        self.n_added += 1
+        heapq.heappush(self.heap, _Doled(
+            sort_key=(-rule.priority, hopcount, self.n_added),
+            url=u.full, hopcount=hopcount, priority=rule.priority))
+        return True
+
+    def _rule_for(self, url: str) -> UrlFilterRule | None:
+        for r in self.filters:
+            if r.matches(url):
+                return r
+        return None
+
+    # --- doling (doledb reads) ---
+
+    def next_batch(self, n: int, now: float | None = None
+                   ) -> list[SpiderRequest]:
+        """Pop up to n urls whose hosts are past their politeness window
+        (SpiderLoop::spiderDoledUrls + per-IP wait semantics)."""
+        now = time.monotonic() if now is None else now
+        out: list[SpiderRequest] = []
+        requeue: list[_Doled] = []
+        while self.heap and len(out) < n:
+            d = heapq.heappop(self.heap)
+            host = normalize(d.url).host
+            if self.host_ready_at.get(host, 0.0) > now:
+                requeue.append(d)
+                continue
+            self.host_ready_at[host] = now + self.host_delay.get(host, 0.25)
+            self.n_doled += 1
+            out.append(SpiderRequest(url=d.url, hopcount=d.hopcount,
+                                     priority=d.priority, added=now))
+        for d in requeue:
+            heapq.heappush(self.heap, d)
+        return out
+
+    def __len__(self) -> int:
+        return len(self.heap)
+
+    @property
+    def exhausted(self) -> bool:
+        return not self.heap

@@ -1,0 +1,154 @@
+"""Crawler tests against a local HTTP site — the reference's deterministic
+"test collection" strategy (``Test.cpp``: spider a fixed url list, then
+verify the resulting databases; SURVEY §4.2), with robots.txt and
+politeness checks folded in (``qaspider`` pattern, ``qa.cpp:2318``).
+"""
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from open_source_search_engine_tpu.index.collection import Collection
+from open_source_search_engine_tpu.query import engine
+from open_source_search_engine_tpu.spider import (
+    Fetcher, Linkdb, SpiderLoop, SpiderScheduler, UrlFilterRule, site_rank)
+
+# a tiny site: home → a, b; a → b, secret; b → a (cycle); secret disallowed
+PAGES = {
+    "/robots.txt": ("text/plain",
+                    "User-agent: *\nDisallow: /secret\n"),
+    "/": ("text/html",
+          "<html><head><title>Home</title></head><body>"
+          "<p>Welcome to the homepage of testsite.</p>"
+          '<a href="/a">page a</a> <a href="/b">page b</a></body></html>'),
+    "/a": ("text/html",
+           "<html><head><title>Alpha</title></head><body>"
+           "<p>Alpha page discusses aardvarks.</p>"
+           '<a href="/b">to b</a> <a href="/secret">hidden</a>'
+           "</body></html>"),
+    "/b": ("text/html",
+           "<html><head><title>Beta</title></head><body>"
+           "<p>Beta page discusses badgers.</p>"
+           '<a href="/a">back to a</a></body></html>'),
+    "/secret": ("text/html",
+                "<html><body><p>classified zebra data</p></body></html>"),
+}
+
+
+class _SiteHandler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        hit = PAGES.get(self.path)
+        if hit is None:
+            self.send_error(404)
+            return
+        ctype, body = hit
+        data = body.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+@pytest.fixture(scope="module")
+def site():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _SiteHandler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+class TestScheduler:
+    def test_dedup_and_hops(self):
+        s = SpiderScheduler(max_hops=1)
+        assert s.add_url("http://x.test/")
+        assert not s.add_url("http://x.test/")          # seen
+        assert s.add_url("http://x.test/p", hopcount=1)
+        assert not s.add_url("http://x.test/q", hopcount=2)  # too deep
+
+    def test_priority_order(self):
+        s = SpiderScheduler(filters=[
+            UrlFilterRule("important", priority=5),
+            UrlFilterRule("*", priority=0)])
+        s.add_url("http://a.test/x")
+        s.add_url("http://b.test/important")
+        batch = s.next_batch(2)
+        assert batch[0].url.endswith("important")
+
+    def test_filter_block(self):
+        s = SpiderScheduler(filters=[
+            UrlFilterRule("spam", allow=False),
+            UrlFilterRule("*")])
+        assert not s.add_url("http://spam.test/page")
+        assert s.add_url("http://ok.test/page")
+
+    def test_politeness_same_host_spacing(self):
+        s = SpiderScheduler(filters=[UrlFilterRule("*", delay_s=60.0)])
+        s.add_url("http://slow.test/1")
+        s.add_url("http://slow.test/2")
+        now = time.monotonic()
+        assert len(s.next_batch(2, now=now)) == 1     # host throttled
+        assert len(s.next_batch(2, now=now)) == 0
+        assert len(s.next_batch(2, now=now + 61)) == 1
+
+
+class TestSiteRank:
+    def test_step_table(self):
+        assert site_rank(0) == 0
+        assert site_rank(1) == 1
+        assert site_rank(7) == 6
+        assert site_rank(100) == 10
+        assert site_rank(10**6) == 15
+
+
+class TestCrawl:
+    @pytest.fixture(scope="class")
+    def crawled(self, tmp_path_factory, site):
+        coll = Collection("crawl", tmp_path_factory.mktemp("crawl"))
+        loop = SpiderLoop(
+            coll,
+            scheduler=SpiderScheduler(
+                filters=[UrlFilterRule("*", delay_s=0.0)], max_hops=3),
+            fetcher=Fetcher(n_threads=4, timeout=5.0))
+        loop.add_url(site + "/")
+        stats = loop.crawl(max_pages=20)
+        return coll, loop, stats, site
+
+    def test_crawl_reaches_linked_pages(self, crawled):
+        coll, loop, stats, site = crawled
+        assert stats.indexed == 3  # home, a, b — not /secret, not robots
+        assert stats.robots_blocked >= 1
+
+    def test_crawled_content_searchable(self, crawled):
+        coll, _, _, site = crawled
+        res = engine.search(coll, "aardvarks")
+        assert len(res.results) == 1
+        assert res.results[0].url.endswith("/a")
+        res = engine.search(coll, "badgers")
+        assert res.results[0].title == "Beta"
+
+    def test_robots_page_not_indexed(self, crawled):
+        coll, _, _, site = crawled
+        assert not engine.search(coll, "zebra").results
+
+    def test_cycle_fetched_once(self, crawled):
+        _, loop, stats, _ = crawled
+        # a↔b cycle must not refetch: 4 fetch attempts total
+        # (/, /a, /b, /secret-blocked)
+        assert stats.fetched == 4
+
+    def test_linkdb_counts_external_only(self, tmp_path):
+        ldb = Linkdb(tmp_path)
+        ldb.add_link("target.com", "linker1.com", "http://linker1.com/x")
+        ldb.add_link("target.com", "linker1.com", "http://linker1.com/y")
+        ldb.add_link("target.com", "linker2.com", "http://linker2.com/")
+        ldb.add_link("target.com", "target.com", "http://target.com/self")
+        assert ldb.site_num_inlinks("target.com") == 2  # distinct sites
+        assert ldb.site_num_inlinks("other.com") == 0
