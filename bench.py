@@ -45,7 +45,7 @@ def _build_corpus(coll, n_docs: int) -> list[str]:
         for s in range(0, n_words, 12):
             sents.append(" ".join(words[s:s + 12]) + ".")
         docproc.index_document(
-            coll, f"http://bench.test/site{d % 97}/doc{d}",
+            coll, f"http://site{d % 97}.bench.test/doc{d}",
             f"<html><head><title>{title}</title></head><body><p>"
             + " ".join(sents) + "</p></body></html>")
     return vocab
@@ -63,6 +63,9 @@ def _make_queries(vocab: list[str], n: int) -> list[str]:
     return qs
 
 
+BATCH = int(os.environ.get("BENCH_BATCH", "32"))
+
+
 def main() -> None:
     from open_source_search_engine_tpu.index.collection import Collection
     from open_source_search_engine_tpu.query import engine
@@ -72,15 +75,24 @@ def main() -> None:
     vocab = _build_corpus(coll, N_DOCS)
     build_s = time.perf_counter() - _t0
     queries = _make_queries(vocab, N_QUERIES)
+    batches = [queries[i:i + BATCH] for i in range(0, len(queries), BATCH)]
 
-    # warmup: populate the jit cache for every shape bucket
-    for q in queries:
-        engine.search(coll, q, topk=10, with_snippets=False)
+    # warmup: build the resident index + populate the jit cache
+    for b in batches:
+        engine.search_device_batch(coll, b, topk=10, with_snippets=False)
+    for q in queries[:20]:
+        engine.search_device(coll, q, topk=10, with_snippets=False)
 
+    # measured: batched resident-index throughput + single-query latency
     t0 = time.perf_counter()
-    for q in queries:
-        engine.search(coll, q, topk=10, with_snippets=False)
+    for b in batches:
+        engine.search_device_batch(coll, b, topk=10, with_snippets=False)
     elapsed = time.perf_counter() - t0
+
+    lat0 = time.perf_counter()
+    for q in queries[:20]:
+        engine.search_device(coll, q, topk=10, with_snippets=False)
+    lat_ms = 1000 * (time.perf_counter() - lat0) / 20
 
     qps = N_QUERIES / elapsed
     print(json.dumps({
@@ -90,9 +102,8 @@ def main() -> None:
         "vs_baseline": round(qps / BASELINE_QPS, 2),
     }))
     print(f"# corpus={N_DOCS} docs ({build_s:.1f}s build), "
-          f"{N_QUERIES} queries in {elapsed:.2f}s, "
-          f"p50 latency ~{1000 * elapsed / N_QUERIES:.1f}ms",
-          file=sys.stderr)
+          f"{N_QUERIES} queries (batch={BATCH}) in {elapsed:.2f}s, "
+          f"single-query latency ~{lat_ms:.1f}ms", file=sys.stderr)
 
 
 if __name__ == "__main__":

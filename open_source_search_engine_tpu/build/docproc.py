@@ -60,6 +60,7 @@ class MetaList:
     links: list[tuple[str, str]]
     langid: int
     site: str
+    words: list[str] | None = None  # doc vocabulary (feeds the Speller)
 
 
 def _density_ranks(hashgroups: np.ndarray, sentences: np.ndarray) -> np.ndarray:
@@ -198,6 +199,7 @@ def build_meta_list(
         links=tdoc.links,
         langid=langid,
         site=u.site,
+        words=words,
     )
 
 
@@ -214,6 +216,8 @@ def index_document(coll: Collection, url: str, content: str, *,
     coll.titledb.add(ml.titledb_key.reshape(1), [ml.title_rec])
     coll.clusterdb.add(ml.clusterdb_key.reshape(1))
     coll.titlerec_cache.pop(ml.docid, None)
+    if ml.words:
+        coll.speller.add_doc_words(ml.words)
     if not old:
         coll.doc_added()
     log.debug("indexed %s docid=%d keys=%d", url, ml.docid, len(ml.posdb_keys))
@@ -255,6 +259,8 @@ def remove_document(coll: Collection, url: str, _count: bool = True) -> bool:
     coll.titledb.add(ml.titledb_key.reshape(1), [b""])
     coll.clusterdb.add(ml.clusterdb_key.reshape(1))
     coll.titlerec_cache.pop(ml.docid, None)
+    if ml.words:
+        coll.speller.remove_doc_words(ml.words)
     if _count:
         coll.doc_removed()
     return True

@@ -1,0 +1,10 @@
+"""Control plane: process lifecycle, autosave, liveness, parm sync.
+
+The reference's L7 (SURVEY §2.8): ``Process.cpp`` orderly save/shutdown +
+autosave, ``PingServer`` heartbeats and dead-host handling, Parms 0x3f
+broadcast. Host-side supervision around the data/query planes.
+"""
+
+from .process import Heartbeat, Process
+
+__all__ = ["Heartbeat", "Process"]

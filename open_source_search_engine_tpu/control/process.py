@@ -1,0 +1,131 @@
+"""Process supervisor — autosave, orderly shutdown, crash-safe state.
+
+Reference: ``Process.cpp/h`` — autosave every N minutes
+(``Process.cpp:1299-1331`` → ``saveRdbTrees``/``saveRdbMaps``
+``Process.cpp:1444-1449``), orderly save+shutdown on request, urgent save
+on fatal signals (``Process.cpp:1595-1612``); plus Msg4's
+``addsinprogress.dat`` crash journal (``Msg4.cpp:86,115``) — here the
+memtable ``saved`` runs serve the same role: every registered savable's
+in-RAM state persists so a clean restart is lossless.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+
+from ..utils.log import get_logger
+
+log = get_logger("process")
+
+
+class Process:
+    """Owns savable components; runs the autosave clock; handles signals."""
+
+    def __init__(self, autosave_minutes: float = 5.0):
+        self._savables: list = []       # objects with .save()
+        self._closers: list = []        # extra shutdown callbacks
+        self.autosave_minutes = autosave_minutes
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.saves = 0
+
+    def register(self, savable) -> None:
+        """Register anything with a .save() (collections, spider state…)."""
+        self._savables.append(savable)
+
+    def on_shutdown(self, fn) -> None:
+        self._closers.append(fn)
+
+    def save_all(self) -> None:
+        """The 'all just save' admin op (``gb save`` broadcast,
+        main.cpp:2392)."""
+        for s in self._savables:
+            try:
+                s.save()
+            except Exception as e:  # noqa: BLE001 — save what we can
+                log.warning("save failed for %r: %s", s, e)
+        self.saves += 1
+
+    # --- autosave clock (Process.cpp:1299 sleep callback) ---
+
+    def start_autosave(self) -> None:
+        if self._thread is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(self.autosave_minutes * 60.0):
+                log.info("autosave")
+                self.save_all()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="autosave")
+        self._thread.start()
+
+    # --- orderly shutdown (Process::shutdown) ---
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self.save_all()
+        for fn in self._closers:
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001
+                log.warning("closer failed: %s", e)
+        log.info("shutdown complete (%d saves)", self.saves)
+
+    def install_signal_handlers(self) -> None:
+        """Urgent save on SIGTERM/SIGINT (Process.cpp:1595 does it for
+        SEGV/HUP too; Python can't catch SEGV meaningfully)."""
+        def handler(signum, frame):
+            log.info("signal %d: saving and exiting", signum)
+            self.shutdown()
+            raise SystemExit(0)
+
+        signal.signal(signal.SIGTERM, handler)
+        signal.signal(signal.SIGINT, handler)
+
+
+class Heartbeat:
+    """Shard liveness prober (PingServer: ``sendPingsToAll``
+    ``PingServer.h:61`` + dead marking feeding Multicast failover).
+
+    In-process shards don't die independently, so the probe is pluggable:
+    multi-host deployments give ``probe(shard_id) -> bool`` an RPC ping;
+    tests flip it to simulate failures. Dead shards are skipped by the
+    query path (degraded serving) until they pass a probe again.
+    """
+
+    def __init__(self, hostmap, probe=None, interval_s: float = 2.0):
+        self.hostmap = hostmap
+        self.probe = probe or (lambda shard: True)
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def check_once(self) -> None:
+        for s in range(self.hostmap.n_shards):
+            alive = False
+            try:
+                alive = bool(self.probe(s))
+            except Exception:  # noqa: BLE001 — probe failure = dead
+                alive = False
+            if alive:
+                self.hostmap.mark_alive(s)
+            else:
+                if self.hostmap.alive[s]:
+                    log.warning("shard %d marked dead", s)
+                self.hostmap.mark_dead(s)
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                self.check_once()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="heartbeat")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

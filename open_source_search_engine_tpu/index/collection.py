@@ -31,6 +31,8 @@ class Collection:
                                    has_data=True)
         self.clusterdb = rdblite.Rdb("clusterdb", self.dir,
                                      clusterdb.KEY_DTYPE)
+        from ..query.speller import Speller
+        self.speller = Speller(self.dir)
         self._stats_path = self.dir / "collstats.json"
         self.num_docs = 0
         self._load_stats()
@@ -60,6 +62,7 @@ class Collection:
     def save(self) -> None:
         for db in (self.posdb, self.titledb, self.clusterdb):
             db.save()
+        self.speller.save()
         self._save_stats()
 
     def dump_all(self) -> None:

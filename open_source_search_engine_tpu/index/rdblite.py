@@ -81,6 +81,11 @@ def searchsorted_keys(sorted_keys: np.ndarray, probe: np.ndarray,
     if n == 0:
         out[:] = 0
         return out
+    from .. import native
+    if native.available():
+        for i in range(m):
+            out[i] = native.searchsorted(sorted_keys, probe[i:i + 1], side)
+        return out
     fields = tuple(reversed(sorted_keys.dtype.names))  # most significant 1st
     cols = {f: sorted_keys[f] for f in fields}
     for i in range(m):
@@ -224,6 +229,16 @@ def merge_batches(batches: list[RecordBatch],
         return nonempty[0]  # sorted single all-positive source: done
     batches = nonempty
     has_data = batches[0].has_data
+
+    if not has_data:
+        # dataless merge rides the native C++ core when built (RdbMerge's
+        # merge_r path); identical semantics to the numpy path below
+        from .. import native
+        merged = native.merge_runs([b.keys for b in batches],
+                                   keep_tombstones) \
+            if native.available() else None
+        if merged is not None:
+            return RecordBatch(merged)
 
     all_keys = np.concatenate([b.keys for b in batches])
     recency = np.concatenate(
@@ -398,6 +413,9 @@ class Rdb:
         self.mem = MemTable(key_dtype, has_data)
         self.runs: list[Run] = []
         self._next_run_id = 0
+        #: bumped on every mutation; device-resident mirrors compare it
+        #: to know when to repack (the Rdb dump/merge → repack cycle)
+        self.version = 0
         self._load_existing_runs()
 
     # --- writes ---
@@ -406,6 +424,7 @@ class Rdb:
         """Add records; auto-dump when the memtable exceeds budget
         (reference dumps at 90% full, ``Rdb.cpp:1172``)."""
         self.mem.add(keys, blobs)
+        self.version += 1
         if self.mem.nbytes >= self.max_memtable_bytes:
             self.dump()
 
@@ -413,6 +432,7 @@ class Rdb:
         """Add tombstones for these keys (delbit cleared)."""
         neg = strip_delbit(np.atleast_1d(keys).astype(self.key_dtype, copy=False))
         self.mem.add(neg, [b""] * len(neg) if self.has_data else None)
+        self.version += 1
 
     def dump(self) -> Run | None:
         """Memtable → new immutable run (RdbDump)."""

@@ -1,0 +1,110 @@
+"""Native host core — C++ storage-engine primitives behind ctypes.
+
+The reference's host plane is C++ (SURVEY §2: "everything is C++"); ours
+keeps the byte-crunching primitives native too: n-way run merge with
+tombstone annihilation, key binary search, and sorted-batch dedup
+(``rdbcore.cpp``). Built on demand with g++ into ``librdbcore.so``;
+every caller has a vectorized-numpy fallback, so the framework works
+(slower) without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+
+from ..utils.log import get_logger
+
+log = get_logger("native")
+
+_DIR = Path(__file__).parent
+_SRC = _DIR / "rdbcore.cpp"
+_SO = _DIR / "librdbcore.so"
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", str(_SRC), "-o", str(_SO)],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except Exception as e:  # noqa: BLE001 — fall back to numpy
+        log.warning("native build failed (numpy fallback in use): %s", e)
+        return False
+
+
+def get_lib():
+    """The loaded librdbcore, building it on first use; None = fallback."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(str(_SO))
+        except OSError as e:
+            log.warning("native load failed: %s", e)
+            return None
+        lib.osse_merge_runs.restype = ctypes.c_int64
+        lib.osse_merge_runs.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p]
+        lib.osse_searchsorted.restype = ctypes.c_int64
+        lib.osse_searchsorted.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_int32]
+        lib.osse_dedup_sorted.restype = ctypes.c_int64
+        lib.osse_dedup_sorted.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int64)]
+        _lib = lib
+        log.info("librdbcore loaded")
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def merge_runs(key_arrays: list[np.ndarray],
+               keep_tombstones: bool) -> np.ndarray | None:
+    """Native n-way merge of sorted structured-key arrays (oldest→newest).
+    Returns merged keys, or None if the native lib is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    key_dtype = key_arrays[0].dtype
+    ks = key_dtype.itemsize
+    bufs = [np.ascontiguousarray(a) for a in key_arrays]
+    total = sum(len(a) for a in bufs)
+    out = np.empty(total, dtype=key_dtype)
+    RunPtrs = ctypes.c_void_p * len(bufs)
+    runs = RunPtrs(*[b.ctypes.data for b in bufs])
+    counts = (ctypes.c_int64 * len(bufs))(*[len(b) for b in bufs])
+    n = lib.osse_merge_runs(
+        runs, counts, len(bufs), ks, int(keep_tombstones),
+        out.ctypes.data)
+    return out[:n].copy()
+
+
+def searchsorted(sorted_keys: np.ndarray, probe: np.ndarray,
+                 side: str) -> int | None:
+    """Native binary search of one probe key; None if lib unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    a = np.ascontiguousarray(sorted_keys)
+    p = np.ascontiguousarray(probe)
+    return int(lib.osse_searchsorted(
+        a.ctypes.data, len(a), a.dtype.itemsize,
+        p.ctypes.data, 1 if side == "right" else 0))

@@ -243,7 +243,11 @@ def sharded_search(sc: ShardedCollection, q: str | QueryPlan, *,
     preps = [prepare_query(c, plan) for c in sc.shards]
     freqw = _global_freq_weights(preps, plan, sc.num_docs)
 
-    packs = [pack_pass(p) for p in preps]
+    # dead shards contribute an empty block: the query degrades instead
+    # of failing, like Multicast skipping dead twins (Multicast.cpp:520);
+    # with replicas configured the replica's collection serves instead
+    packs = [pack_pass(p) if sc.hostmap.alive[i] else None
+             for i, p in enumerate(preps)]
     live = [p for p in packs if p is not None]
     if not live:
         return SearchResults(query=plan.raw, total_matches=0)

@@ -1,0 +1,321 @@
+"""Device-resident index — the shard's termlists live in HBM.
+
+This is the SURVEY §7 architecture stated plainly: "posting lists as
+padded int32/int64 HBM arrays … the device query plane". The host-packed
+path (packer.py) ships each query's termlists to the device — correct,
+but on tunneled TPU backends the per-query transfer dwarfs the compute.
+Here the whole shard's posting store uploads ONCE; a query ships only
+its term-run offsets (a few dozen int32s) and gets the packed top-k
+back: one RPC up, one down. Queries also batch (vmap over the query
+axis) — the throughput mode the reference's per-query callback
+architecture fundamentally cannot express.
+
+Layout (built from the Rdb, reference Msg2/RdbList read path collapsed):
+
+* postings sorted by (termid, docid, wordpos) — posdb key order — as two
+  resident columns: ``docidx`` int32 [N] (posting → doc-table index) and
+  ``payload`` uint32 [N] (wordpos|hg|density|spam bits, packer layout);
+* a host-side term directory termid → [start, end) run (``RdbMap``'s
+  role, one binary search per query sublist);
+* a doc table: docids uint64 [D] (host) + siterank/langid int32 [D]
+  (device) — Clusterdb's query-time role.
+
+Per query the device kernel gathers each sublist's run, computes
+per-(sublist, doc) occurrence ranks (the mini-merge), scatters into the
+[D, T, P] cube and reuses scorer.score_cube — identical semantics to the
+host-packed path, bit for bit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..index import posdb
+from ..index.collection import Collection
+from ..utils.log import get_logger
+from . import weights
+from .compiler import QueryPlan, compile_query
+from .packer import (MAX_POSITIONS, T_FLOOR, _bucket, _pad1, group_flags)
+from .scorer import scatter_cube, score_cube
+
+log = get_logger("devindex")
+
+#: row-plan bucket floors (distinct (R, L) pairs = one compile each)
+R_FLOOR = 8
+RUN_FLOOR = 512
+#: per-sublist run cap — the reference's tiered termlist truncation
+#: (SURVEY §5 long-context: IndexReadInfo bounded list reads); runs
+#: longer than this score only their first MAX_RUN postings, while
+#: term-frequency weights still use the full document frequency
+MAX_RUN = 1 << 15
+
+
+@dataclass
+class ResidentPlan:
+    """Host-computed gather plan for one query (all tiny arrays)."""
+
+    start: np.ndarray    # int32 [R] posting-run starts
+    length: np.ndarray   # int32 [R] run lengths (0 = empty sublist)
+    group: np.ndarray    # int32 [R] row → term group
+    base: np.ndarray     # int32 [R] slot base within the group's P slots
+    quota: np.ndarray    # int32 [R] max positions per (row, doc)
+    freq_weight: np.ndarray  # float32 [T]
+    required: np.ndarray     # bool [T]
+    negative: np.ndarray     # bool [T]
+    scored: np.ndarray       # bool [T]
+    qlang: int
+    matchable: bool      # False = a required group has no postings
+
+
+class DeviceIndex:
+    """One collection's postings, resident on the default device."""
+
+    def __init__(self, coll: Collection, max_positions: int = MAX_POSITIONS):
+        self.coll = coll
+        self.P = max_positions
+        self._built_version = -1
+        self.refresh()
+
+    # --- build / refresh -------------------------------------------------
+
+    def refresh(self) -> bool:
+        """(Re)build device arrays if the underlying Rdb changed — the
+        dump/merge→repack cycle of SURVEY §7 hard part (d)."""
+        v = self.coll.posdb.version
+        if v == self._built_version:
+            return False
+        batch = self.coll.posdb.get_all()
+        f = posdb.unpack(batch.keys) if len(batch) else None
+        if f is None:
+            n = 0
+            termids = np.empty(0, np.uint64)
+            docids = np.empty(0, np.uint64)
+            payload = np.empty(0, np.uint32)
+            siterank = langid = np.empty(0, np.uint64)
+        else:
+            n = len(batch)
+            termids = f["termid"]
+            docids = f["docid"]
+            payload = (
+                f["wordpos"].astype(np.uint32)
+                | f["hashgroup"].astype(np.uint32) << np.uint32(18)
+                | f["densityrank"].astype(np.uint32) << np.uint32(22)
+                | f["wordspamrank"].astype(np.uint32) << np.uint32(27)
+            )
+            siterank = f["siterank"]
+            langid = f["langid"]
+
+        # doc table (sorted unique docids); posting → doc index
+        self.doc_docids = np.unique(docids)
+        D = len(self.doc_docids)
+        self.D_pad = _bucket(max(D, 1), 256)
+        docidx = np.searchsorted(self.doc_docids, docids).astype(np.int32) \
+            if n else np.empty(0, np.int32)
+        dsr = np.zeros(self.D_pad, np.int32)
+        dlang = np.zeros(self.D_pad, np.int32)
+        if n:
+            # first posting per doc supplies siterank/langid
+            # (reference: getSiteRank(miniMergedList[0]), Posdb.cpp:6989)
+            first = np.unique(docidx, return_index=True)[1]
+            dsr[docidx[first]] = siterank[first].astype(np.int32)
+            dlang[docidx[first]] = langid[first].astype(np.int32)
+
+        # term directory: termid → posting run (the RdbMap role)
+        self.dir_termids, dir_first = np.unique(termids, return_index=True)
+        self.dir_start = np.r_[dir_first, n].astype(np.int64)
+
+        self.n_postings = n
+        self.h_docidx = docidx  # host copy: exact per-group doc freqs
+        self.d_docidx = jax.device_put(docidx)
+        self.d_payload = jax.device_put(payload)
+        self.d_siterank = jax.device_put(dsr)
+        self.d_doclang = jax.device_put(dlang)
+        self._built_version = v
+        log.info("device index built: %d postings, %d docs, %d terms",
+                 n, D, len(self.dir_termids))
+        return True
+
+    @property
+    def n_docs(self) -> int:
+        return len(self.doc_docids)
+
+    # --- planning --------------------------------------------------------
+
+    def _run_of(self, termid: int) -> tuple[int, int]:
+        i = int(np.searchsorted(self.dir_termids, np.uint64(termid)))
+        if i >= len(self.dir_termids) or self.dir_termids[i] != termid:
+            return 0, 0
+        return int(self.dir_start[i]), int(self.dir_start[i + 1])
+
+    def plan(self, qplan: QueryPlan) -> ResidentPlan:
+        T = _bucket(max(len(qplan.groups), 1), T_FLOOR)
+        rows = []
+        freq = np.zeros(len(qplan.groups), np.int64)
+        matchable = True
+        for g_i, g in enumerate(qplan.groups):
+            subs = g.sublists
+            quota = max(self.P // max(len(subs), 1), 1)
+            runs = []
+            for s_i, sub in enumerate(subs):
+                a, b = self._run_of(sub.termid)
+                rows.append((a, min(b - a, MAX_RUN), g_i, s_i * quota,
+                             quota))
+                if b > a:
+                    runs.append((a, b))
+            if runs:
+                # group document frequency = unique docs across the
+                # group's sublists (a doc holding both the word and its
+                # bigram counts once — matches the host packer's
+                # np.unique over the mini-merged list)
+                freq[g_i] = len(np.unique(np.concatenate(
+                    [self.h_docidx[a:b] for a, b in runs])))
+            elif g.required and not g.negative:
+                matchable = False
+        required, negative, scored = group_flags(qplan, T)
+        freqw = _pad1(
+            weights.term_freq_weight(freq, max(self.coll.num_docs, 1)),
+            T, 0.5)
+        r = np.array(rows, np.int64).reshape(-1, 5) if rows else \
+            np.zeros((0, 5), np.int64)
+        return ResidentPlan(
+            start=r[:, 0].astype(np.int32), length=r[:, 1].astype(np.int32),
+            group=r[:, 2].astype(np.int32), base=r[:, 3].astype(np.int32),
+            quota=r[:, 4].astype(np.int32),
+            freq_weight=freqw, required=required, negative=negative,
+            scored=scored, qlang=qplan.lang, matchable=matchable)
+
+    def _pad_plan(self, p: ResidentPlan, R: int):
+        def pad(a, fill=0):
+            out = np.full(R, fill, a.dtype)
+            out[: len(a)] = a
+            return out
+        return (pad(p.start), pad(p.length), pad(p.group), pad(p.base),
+                pad(p.quota, 1))
+
+    # --- execution -------------------------------------------------------
+
+    def search(self, q: str | QueryPlan, topk: int = 64, lang: int = 0):
+        """One query → (docids, scores, n_matched)."""
+        out = self.search_batch([q], topk=topk, lang=lang)
+        return out[0]
+
+    def search_batch(self, queries, topk: int = 64, lang: int = 0):
+        """Batched execution: B queries in ONE device round trip (vmap
+        over the query axis). Returns [(docids, scores, n_matched)] per
+        query, order preserved."""
+        qplans = [q if isinstance(q, QueryPlan) else compile_query(q, lang)
+                  for q in queries]
+        plans = [self.plan(qp) for qp in qplans]
+        live = [i for i, p in enumerate(plans)
+                if p.matchable and len(p.start)]
+        results = [(np.empty(0, np.uint64), np.empty(0, np.float32), 0)
+                   ] * len(plans)
+        if not live:
+            return results
+        # quantize shape buckets coarsely (powers of four) — every
+        # distinct (B, R, L) triple is an XLA compile; wasted lanes are
+        # masked compute, recompiles are 20-40s stalls
+        R = _bucket(max(len(plans[i].start) for i in live), R_FLOOR)
+        L = RUN_FLOOR
+        need_l = max((int(plans[i].length.max()) for i in live), default=1)
+        while L < need_l:
+            L <<= 2
+        T = max(len(plans[i].required) for i in live)
+        # pad the batch axis to a bucket too: a single query rides the
+        # same compiled kernel as a small batch (padding rows are empty
+        # plans — near-free lanes)
+        B = _bucket(len(live), 4)
+        pad_n = B - len(live)
+        k = min(topk, self.D_pad)
+
+        # per-group arrays re-pad to the BATCH-wide T bucket (plans in
+        # one batch may straddle the T_FLOOR boundary)
+        stack = lambda f: np.stack(
+            [_pad1(f(plans[i]), T, 0) for i in live]
+            + [_pad1(f(plans[live[0]]) * 0, T, 0) for _ in range(pad_n)])
+        padded = ([self._pad_plan(plans[i], R) for i in live]
+                  + [tuple(np.zeros_like(x)
+                           for x in self._pad_plan(plans[live[0]], R))
+                     ] * pad_n)
+        args = (
+            np.stack([p[0] for p in padded]),  # start [B, R]
+            np.stack([p[1] for p in padded]),  # length
+            np.stack([p[2] for p in padded]),  # group
+            np.stack([p[3] for p in padded]),  # base
+            np.stack([p[4] for p in padded]),  # quota
+            stack(lambda p: p.freq_weight),
+            stack(lambda p: p.required),
+            stack(lambda p: p.negative),
+            stack(lambda p: p.scored),
+            np.array([plans[i].qlang for i in live]
+                     + [0] * pad_n, np.int32),
+        )
+        dev_args = jax.device_put(list(args))
+        out = np.asarray(_resident_batch(
+            self.d_docidx, self.d_payload, self.d_siterank, self.d_doclang,
+            *dev_args, n_docs=self.n_docs, n_positions=self.P,
+            run_l=L, n_groups=T, topk=k))  # [B, 1 + 2k]
+
+        for b, i in enumerate(live):
+            row = out[b]
+            n_matched = int(row[0])
+            idx = row[1:1 + k].astype(np.int64)
+            scores = row[1 + k:].view(np.float32)
+            keep = scores > 0.0
+            results[i] = (self.doc_docids[np.clip(idx[keep], 0,
+                                                  max(self.n_docs - 1, 0))],
+                          scores[keep], n_matched)
+        return results
+
+
+@partial(jax.jit,
+         static_argnames=("n_docs", "n_positions", "run_l", "n_groups",
+                          "topk"))
+def _resident_batch(d_docidx, d_payload, d_siterank, d_doclang,
+                    start, length, group, base, quota, freqw, required,
+                    negative, scored, qlang,
+                    n_docs: int, n_positions: int, run_l: int,
+                    n_groups: int, topk: int):
+    """vmapped resident kernel: gather runs → rank → cube → score."""
+    D = d_siterank.shape[0]
+    N = max(d_docidx.shape[0], 1)
+    L = run_l
+
+    def one(start, length, group, base, quota, freqw, required, negative,
+            scored, qlang):
+        lane = jnp.arange(L, dtype=jnp.int32)[None, :]
+        idx = jnp.clip(start[:, None] + lane, 0, N - 1)
+        valid = lane < length[:, None]                      # [R, L]
+        docrow = jnp.where(valid, d_docidx[idx], D)         # sorted per row
+        payrow = d_payload[idx]
+        # occurrence rank within each (row, doc): rows are docid-sorted,
+        # so the first index of each docid run is a running max over
+        # change markers — an O(L) associative scan (searchsorted here
+        # would be O(L·logL) of gathers, pathological on TPU)
+        change = jnp.concatenate(
+            [jnp.ones((docrow.shape[0], 1), bool),
+             docrow[:, 1:] != docrow[:, :-1]], axis=1)
+        first = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(change, lane, 0), axis=1)
+        rank = lane - first
+        slot = base[:, None] + rank
+        valid = valid & (rank < quota[:, None])
+        cube, pvalid = scatter_cube(docrow, payrow, slot, valid, D,
+                                    n_positions, row_group=group,
+                                    n_groups=n_groups)
+        n_matched, ts, ti = score_cube(
+            cube, pvalid, freqw, required, negative, scored,
+            d_siterank, d_doclang, qlang, jnp.int32(n_docs), topk=topk)
+        return jnp.concatenate([
+            jnp.atleast_1d(n_matched.astype(jnp.uint32)),
+            ti.astype(jnp.uint32),
+            jax.lax.bitcast_convert_type(ts, jnp.uint32),
+        ])
+
+    return jax.vmap(one)(start, length, group, base, quota, freqw,
+                         required, negative, scored, qlang)

@@ -20,6 +20,7 @@ import numpy as np
 from ..build import docproc
 from ..index.collection import Collection
 from ..utils.log import get_logger
+from ..utils.stats import g_stats
 from .compiler import QueryPlan, compile_query
 from .packer import pack_pass, prepare_query
 from .scorer import run_query
@@ -48,22 +49,26 @@ class SearchResults:
     total_matches: int
     results: list[Result] = field(default_factory=list)
     clustered: int = 0  # results hidden by site clustering (Msg51)
+    suggestion: str | None = None  # "did you mean" (Speller)
 
 
 def build_results(get_doc, docids, scores, plan: QueryPlan, *,
                   topk: int, with_snippets: bool = True,
-                  site_cluster: bool = True) -> tuple[list[Result], int]:
+                  site_cluster: bool = True,
+                  dedup_content: bool = True) -> tuple[list[Result], int]:
     """Msg40's post-merge stage: walk merged candidates best-first, fetch
-    titlerecs from the owning store (Msg20/Msg22), apply site clustering
+    titlerecs from the owning store (Msg20/Msg22), apply content-hash
+    dedup (Msg40's checksum dedup of identical pages) and site clustering
     (Msg51: at most MAX_PER_SITE per site, rest hidden), build summaries.
 
     ``get_doc`` is docid → titlerec dict (routes to the owning shard in
-    the mesh path). Returns (results, number clustered away).
+    the mesh path). Returns (results, number hidden by cluster/dedup).
     """
     from . import summary as summary_mod
 
     words = [g.display for g in plan.scored_groups]
     per_site: dict[str, int] = {}
+    seen_hashes: set[int] = set()
     results: list[Result] = []
     clustered = 0
     for docid, score in zip(docids, scores):
@@ -77,6 +82,12 @@ def build_results(get_doc, docids, scores, plan: QueryPlan, *,
             r.url = rec.get("url", "")
             r.title = rec.get("title", "")
             r.site = rec.get("site", "")
+            ch = rec.get("content_hash")
+            if dedup_content and ch is not None:
+                if ch in seen_hashes:
+                    clustered += 1
+                    continue
+                seen_hashes.add(ch)
             if site_cluster and r.site:
                 seen = per_site.get(r.site, 0)
                 if seen >= MAX_PER_SITE:
@@ -98,7 +109,9 @@ def search(coll: Collection, q: str | QueryPlan, *, topk: int = 10,
     plan = q if isinstance(q, QueryPlan) else compile_query(q, lang=lang)
     raw = plan.raw
 
-    prep = prepare_query(coll, plan)
+    g_stats.count("query")
+    with g_stats.timed("query.prepare"):
+        prep = prepare_query(coll, plan)
 
     # over-fetch + escalate: when site clustering leaves the page short,
     # re-score with a larger k (the Msg40 recall loop, Msg40.cpp:2117,
@@ -112,28 +125,83 @@ def search(coll: Collection, q: str | QueryPlan, *, topk: int = 10,
         all_scores: list[np.ndarray] = []
         total = 0
         for offset in range(0, len(prep.cand), max_docs_per_pass):
-            pq = pack_pass(prep, doc_offset=offset,
-                           max_docs=max_docs_per_pass)
+            with g_stats.timed("query.pack"):
+                pq = pack_pass(prep, doc_offset=offset,
+                               max_docs=max_docs_per_pass)
             if pq is None:
                 break
-            docids, scores, n_matched = run_query(pq, topk=k)
+            with g_stats.timed("query.score"):
+                docids, scores, n_matched = run_query(pq, topk=k)
             total += n_matched
             all_docids.append(docids)
             all_scores.append(scores)
 
         if not all_docids:
-            return SearchResults(query=raw, total_matches=0)
+            return SearchResults(query=raw, total_matches=0,
+                                 suggestion=_suggest(coll, plan))
         docids = np.concatenate(all_docids)
         scores = np.concatenate(all_scores)
         order = np.argsort(-scores, kind="stable")
 
-        results, clustered = build_results(
-            lambda d: docproc.get_document(coll, docid=d),
-            docids[order], scores[order], plan, topk=topk,
-            with_snippets=with_snippets, site_cluster=site_cluster)
+        with g_stats.timed("query.results"):
+            results, clustered = build_results(
+                lambda d: docproc.get_document(coll, docid=d),
+                docids[order], scores[order], plan, topk=topk,
+                with_snippets=with_snippets, site_cluster=site_cluster)
         if (len(results) >= topk or clustered == 0
                 or k >= len(prep.cand)):
             break
         k *= 4
-    return SearchResults(query=raw, total_matches=total, results=results,
-                         clustered=clustered)
+    return SearchResults(
+        query=raw, total_matches=total, results=results,
+        clustered=clustered,
+        suggestion=_suggest(coll, plan) if total == 0 else None)
+
+
+def _suggest(coll: Collection, plan: QueryPlan) -> str | None:
+    """Zero-result fallback: Speller "did you mean" over the query's
+    scored words (reference Msg40 spell-check integration)."""
+    words = [g.display for g in plan.scored_groups if " " not in g.display]
+    return coll.speller.suggest_query(words) if words else None
+
+
+def get_device_index(coll: Collection):
+    """The collection's HBM-resident index, built lazily and refreshed
+    when the Rdb version moves (cached on the Collection object)."""
+    from .devindex import DeviceIndex
+    di = getattr(coll, "_device_index", None)
+    if di is None:
+        di = DeviceIndex(coll)
+        coll._device_index = di
+    else:
+        di.refresh()
+    return di
+
+
+def search_device_batch(coll: Collection, queries, *, topk: int = 10,
+                        lang: int = 0, with_snippets: bool = True,
+                        site_cluster: bool = True) -> list[SearchResults]:
+    """Batched resident-index search: B queries in one device round trip
+    (the TPU throughput mode — vmap over queries, SURVEY §7.8)."""
+    di = get_device_index(coll)
+    plans = [q if isinstance(q, QueryPlan) else compile_query(q, lang)
+             for q in queries]
+    g_stats.count("query", len(plans))
+    with g_stats.timed("query.device_batch"):
+        raw = di.search_batch(plans, topk=max(topk * 2, 64), lang=lang)
+    out = []
+    for plan, (docids, scores, n_matched) in zip(plans, raw):
+        results, clustered = build_results(
+            lambda d: docproc.get_document(coll, docid=d),
+            docids, scores, plan, topk=topk,
+            with_snippets=with_snippets, site_cluster=site_cluster)
+        out.append(SearchResults(
+            query=plan.raw, total_matches=n_matched, results=results,
+            clustered=clustered,
+            suggestion=_suggest(coll, plan) if n_matched == 0 else None))
+    return out
+
+
+def search_device(coll: Collection, q, **kw) -> SearchResults:
+    """Single-query resident-index search (one RPC up, one down)."""
+    return search_device_batch(coll, [q], **kw)[0]

@@ -57,28 +57,39 @@ def _decode(payload: jnp.ndarray):
     return wordpos, hg, den, spam, syn
 
 
-def score_core(doc_idx, payload, slot, valid, freq_weight, required,
-               negative, scored, siterank, doclang, qlang, n_docs,
-               n_positions: int = MAX_POSITIONS, topk: int = 64):
-    """Score every candidate doc and return (match count, top scores, top
-    doc indices). Pure traced function — called under plain jit for the
-    single-shard path and inside ``shard_map`` for the mesh path.
-
-    Shapes: doc_idx/payload/slot/valid [T, L]; freq_weight/required/
-    negative/scored [T]; siterank/doclang [D]; qlang/n_docs scalars.
-    """
-    T, L = doc_idx.shape
-    D = siterank.shape[0]
+def scatter_cube(doc_idx, payload, slot, valid, n_docs_padded: int,
+                 n_positions: int, row_group=None, n_groups: int | None
+                 = None):
+    """Scatter posting rows into the dense position cube
+    ``[D, n_groups, P]`` (+ validity). ``row_group`` maps each row of
+    ``doc_idx`` to its term group — identity when rows ARE groups (the
+    host-packed path); the device-resident path gathers one row per
+    *sublist* and folds them into groups here (the mini-merge,
+    ``Posdb.cpp`` miniMergeBuf, as a scatter index)."""
+    R, L = doc_idx.shape
+    D = n_docs_padded
     P = n_positions
-
-    # ---- scatter postings into the dense position cube [D+1, T, P] ----
-    # (row D is the dump row for padded postings; doc_idx==D there)
-    t_of = jnp.broadcast_to(jnp.arange(T)[:, None], (T, L))
+    T = n_groups if n_groups is not None else R
+    if row_group is None:
+        g_of = jnp.broadcast_to(jnp.arange(R)[:, None], (R, L))
+    else:
+        g_of = jnp.broadcast_to(row_group[:, None], (R, L))
     cube = jnp.zeros((D + 1, T, P), jnp.uint32)
-    cube = cube.at[doc_idx, t_of, slot].set(payload, mode="drop")
+    cube = cube.at[doc_idx, g_of, slot].set(payload, mode="drop")
     pvalid = jnp.zeros((D + 1, T, P), jnp.bool_)
-    pvalid = pvalid.at[doc_idx, t_of, slot].set(valid, mode="drop")
-    cube, pvalid = cube[:D], pvalid[:D]
+    pvalid = pvalid.at[doc_idx, g_of, slot].set(valid, mode="drop")
+    return cube[:D], pvalid[:D]
+
+
+def score_cube(cube, pvalid, freq_weight, required, negative, scored,
+               siterank, doclang, qlang, n_docs, topk: int = 64):
+    """Score the dense position cube — the docIdLoop replacement.
+
+    Shapes: cube/pvalid [D, T, P]; freq_weight/required/negative/scored
+    [T]; siterank/doclang [D]; qlang/n_docs scalars. Returns (match
+    count, top scores [k], top doc indices [k]).
+    """
+    D, T, P = cube.shape
 
     wordpos, hg, den, spam, syn = _decode(cube)
 
@@ -171,6 +182,18 @@ def score_core(doc_idx, payload, slot, valid, freq_weight, required,
     top_scores, top_idx = jax.lax.top_k(final, k)
     n_matched = jnp.sum(match)
     return n_matched, top_scores, top_idx
+
+
+def score_core(doc_idx, payload, slot, valid, freq_weight, required,
+               negative, scored, siterank, doclang, qlang, n_docs,
+               n_positions: int = MAX_POSITIONS, topk: int = 64):
+    """Host-packed entry: scatter rows (1 row = 1 group) then score.
+    Pure traced function — called under plain jit for the single-shard
+    path and inside ``shard_map`` for the mesh path."""
+    cube, pvalid = scatter_cube(doc_idx, payload, slot, valid,
+                                siterank.shape[0], n_positions)
+    return score_cube(cube, pvalid, freq_weight, required, negative,
+                      scored, siterank, doclang, qlang, n_docs, topk=topk)
 
 
 score_and_topk = jax.jit(score_core, static_argnames=("n_positions", "topk"))

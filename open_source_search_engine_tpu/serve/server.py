@@ -46,6 +46,7 @@ def render_results(res: engine.SearchResults, fmt: str) -> tuple[str, str]:
             "query": res.query,
             "totalMatches": res.total_matches,
             "clustered": res.clustered,
+            "suggestion": res.suggestion,
             "results": [
                 {"docId": r.docid, "score": r.score, "url": r.url,
                  "title": r.title, "snippet": r.snippet, "site": r.site}
@@ -137,6 +138,11 @@ class SearchHTTPServer:
             return 200, json.dumps(self.stats), "application/json"
         if path == "/admin/hosts":
             return 200, self._page_hosts(), "application/json"
+        if path == "/admin/perf":
+            from ..utils.stats import g_stats
+            return 200, json.dumps(g_stats.snapshot()), "application/json"
+        if path == "/admin/parms":
+            return self._page_parms(query)
         return 404, json.dumps({"error": "no such page"}), \
             "application/json"
 
@@ -214,6 +220,33 @@ class SearchHTTPServer:
                 "application/json"
         self.spider.add_url(url)
         return 200, json.dumps({"queued": url}), "application/json"
+
+    def _page_parms(self, query: dict) -> tuple[int, str, str]:
+        """Parameter view + live update via cgi names — the Parms URL api
+        (``&maxmem=...``); updates fire the conf's on_update listeners
+        (the 0x3f cluster-broadcast hook)."""
+        from ..utils import parms as parms_mod
+        coll = self._coll(query)
+        updated = {}
+        for cgi, value in query.items():
+            if cgi in ("c",):
+                continue
+            for target in (coll.conf,):
+                try:
+                    target.set_from_cgi(cgi, value)
+                    updated[cgi] = value
+                    break
+                except KeyError:
+                    continue
+        table = [{
+            "name": p.name, "cgi": p.cgi, "type": p.type.__name__,
+            "default": p.default, "scope": p.scope, "desc": p.desc,
+        } for p in parms_mod.parm_table()]
+        return 200, json.dumps({
+            "updated": updated,
+            "coll": coll.conf.to_dict(),
+            "table": table,
+        }), "application/json"
 
     def _page_hosts(self) -> str:
         """Shard/cluster map (PageHosts.cpp)."""

@@ -20,9 +20,11 @@ same HostMap.
 from __future__ import annotations
 
 import heapq
+import json
 import re
 import time
 from dataclasses import dataclass, field
+from pathlib import Path
 
 from ..utils import ghash
 from ..utils.url import normalize
@@ -152,3 +154,33 @@ class SpiderScheduler:
     @property
     def exhausted(self) -> bool:
         return not self.heap
+
+    # --- persistence (spiderdb -saved.dat + addsinprogress journal) ---
+
+    def save_to(self, path: str | Path) -> None:
+        """Persist frontier + seen set so a restart resumes the crawl
+        (the reference persists spiderdb's tree and replays
+        ``addsinprogress.dat``, ``Msg4.cpp:115``)."""
+        Path(path).write_text(json.dumps({
+            "seen": list(self.seen),
+            "heap": [[list(d.sort_key), d.url, d.hopcount, d.priority]
+                     for d in self.heap],
+            "roots": sorted(self.roots),
+            "n_added": self.n_added,
+            "n_doled": self.n_doled,
+        }))
+
+    def load_from(self, path: str | Path) -> bool:
+        p = Path(path)
+        if not p.exists():
+            return False
+        state = json.loads(p.read_text())
+        self.seen = set(state["seen"])
+        self.heap = [_Doled(sort_key=tuple(k), url=u, hopcount=h,
+                            priority=pr)
+                     for k, u, h, pr in state["heap"]]
+        heapq.heapify(self.heap)
+        self.roots = set(state["roots"])
+        self.n_added = state["n_added"]
+        self.n_doled = state["n_doled"]
+        return True

@@ -1,0 +1,123 @@
+"""Metrics — counters, latency histograms, and time-series samples.
+
+Reference: ``Stats.cpp/h`` (in-RAM per-message latency stats drawn on
+PagePerf, ``Stats.h:38`` ``addStat_r``) + ``Statsdb`` (an actual Rdb of
+per-second multi-metric samples graphed on PageStatsdb, ``Statsdb.h:24``).
+
+One registry: named counters, named latency recorders (count/sum/min/max
++ fixed log2 histogram — enough to derive p50/p99 without storing every
+sample), and a bounded per-second time-series ring. All host-side and
+lock-cheap; the device never sees this.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+_BUCKETS = 24  # log2 ms buckets: <1ms ... >2^22ms
+
+
+@dataclass
+class LatencyStat:
+    count: int = 0
+    total_ms: float = 0.0
+    min_ms: float = float("inf")
+    max_ms: float = 0.0
+    histo: list[int] = field(default_factory=lambda: [0] * _BUCKETS)
+
+    def add(self, ms: float) -> None:
+        self.count += 1
+        self.total_ms += ms
+        self.min_ms = min(self.min_ms, ms)
+        self.max_ms = max(self.max_ms, ms)
+        b = 0
+        v = ms
+        while v >= 1.0 and b < _BUCKETS - 1:
+            v /= 2.0
+            b += 1
+        self.histo[b] += 1
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile from the log2 histogram (bucket upper
+        bound)."""
+        if not self.count:
+            return 0.0
+        want = q * self.count
+        seen = 0
+        for b, n in enumerate(self.histo):
+            seen += n
+            if seen >= want:
+                return float(2 ** b)
+        return self.max_ms
+
+    def to_dict(self) -> dict:
+        return {
+            "count": self.count,
+            "avg_ms": self.total_ms / self.count if self.count else 0.0,
+            "min_ms": 0.0 if self.count == 0 else self.min_ms,
+            "max_ms": self.max_ms,
+            "p50_ms": self.quantile(0.50),
+            "p99_ms": self.quantile(0.99),
+        }
+
+
+class Stats:
+    """Process-wide metrics registry (``g_stats`` equivalent)."""
+
+    def __init__(self, timeseries_window: int = 600):
+        self._lock = threading.Lock()
+        self.counters: dict[str, int] = {}
+        self.latencies: dict[str, LatencyStat] = {}
+        #: per-second samples: (epoch_s, {metric: value}) ring
+        self.timeseries: deque = deque(maxlen=timeseries_window)
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def record_ms(self, name: str, ms: float) -> None:
+        with self._lock:
+            self.latencies.setdefault(name, LatencyStat()).add(ms)
+
+    def timed(self, name: str):
+        """Context manager: ``with g_stats.timed("query"): ...``."""
+        return _Timer(self, name)
+
+    def sample(self, **metrics: float) -> None:
+        """Append a Statsdb-style timestamped sample row."""
+        with self._lock:
+            self.timeseries.append((time.time(), dict(metrics)))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self.counters),
+                "latencies": {k: v.to_dict()
+                              for k, v in self.latencies.items()},
+            }
+
+    def series(self, last_s: float = 600.0) -> list:
+        cutoff = time.time() - last_s
+        with self._lock:
+            return [(t, m) for t, m in self.timeseries if t >= cutoff]
+
+
+class _Timer:
+    def __init__(self, stats: Stats, name: str):
+        self.stats, self.name = stats, name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.stats.record_ms(self.name,
+                             1000.0 * (time.perf_counter() - self.t0))
+        return False
+
+
+#: process-wide singleton (reference ``g_stats``)
+g_stats = Stats()

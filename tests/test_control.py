@@ -1,0 +1,134 @@
+"""Control plane tests: stats, process autosave/shutdown, heartbeat
+failover, spider persistence, parms endpoint."""
+
+import json
+import urllib.request
+
+import pytest
+
+from open_source_search_engine_tpu.control import Heartbeat, Process
+from open_source_search_engine_tpu.index.collection import Collection
+from open_source_search_engine_tpu.parallel import HostMap
+from open_source_search_engine_tpu.spider import SpiderScheduler
+from open_source_search_engine_tpu.utils.stats import Stats
+
+
+class TestStats:
+    def test_counters_and_latency(self):
+        s = Stats()
+        s.count("q")
+        s.count("q", 2)
+        s.record_ms("op", 3.0)
+        s.record_ms("op", 30.0)
+        snap = s.snapshot()
+        assert snap["counters"]["q"] == 3
+        assert snap["latencies"]["op"]["count"] == 2
+        assert 3.0 <= snap["latencies"]["op"]["avg_ms"] <= 30.0
+        assert snap["latencies"]["op"]["max_ms"] == 30.0
+
+    def test_timed_context(self):
+        s = Stats()
+        with s.timed("x"):
+            pass
+        assert s.snapshot()["latencies"]["x"]["count"] == 1
+
+    def test_timeseries_window(self):
+        s = Stats(timeseries_window=3)
+        for i in range(5):
+            s.sample(v=float(i))
+        rows = s.series()
+        assert len(rows) == 3 and rows[-1][1]["v"] == 4.0
+
+
+class TestProcess:
+    def test_save_all_and_shutdown(self, tmp_path):
+        coll = Collection("proc", tmp_path)
+        proc = Process()
+        proc.register(coll)
+        closed = []
+        proc.on_shutdown(lambda: closed.append(1))
+        proc.save_all()
+        assert proc.saves == 1
+        proc.shutdown()
+        assert closed == [1] and proc.saves == 2
+
+    def test_restart_recovers_memtable(self, tmp_path):
+        from open_source_search_engine_tpu.build import docproc
+        from open_source_search_engine_tpu.query import engine
+        c1 = Collection("re", tmp_path)
+        docproc.index_document(
+            c1, "http://r.test/p",
+            "<html><title>T</title><body>persistent walrus</body></html>")
+        Process().register(c1)
+        c1.save()
+        c2 = Collection("re", tmp_path)  # fresh process
+        c2.num_docs = 1  # collstats written by save()
+        assert engine.search(c2, "walrus").results
+
+
+class TestHeartbeat:
+    def test_dead_marking_and_recovery(self):
+        hm = HostMap(4)
+        down = {2}
+        hb = Heartbeat(hm, probe=lambda s: s not in down)
+        hb.check_once()
+        assert list(hm.alive) == [True, True, False, True]
+        down.clear()
+        hb.check_once()
+        assert all(hm.alive)
+
+    def test_dead_shard_degrades_not_fails(self, tmp_path):
+        import jax
+        from open_source_search_engine_tpu.parallel import (
+            ShardedCollection, make_mesh, sharded_search)
+        sc = ShardedCollection("hb", tmp_path, n_shards=2)
+        mesh = make_mesh(2, devices=jax.devices()[:2])
+        for i in range(8):
+            sc.index_document(
+                f"http://h{i}.test/", f"<html><body>failover doc {i}"
+                "</body></html>")
+        full = sharded_search(sc, "failover", mesh=mesh, topk=10)
+        assert full.total_matches == 8
+        sc.hostmap.mark_dead(0)
+        part = sharded_search(sc, "failover", mesh=mesh, topk=10)
+        assert 0 < part.total_matches < 8  # degraded, not an error
+
+
+class TestSpiderPersistence:
+    def test_save_load_roundtrip(self, tmp_path):
+        s = SpiderScheduler()
+        s.add_url("http://a.test/1")
+        s.add_url("http://a.test/2")
+        s.next_batch(1)
+        s.save_to(tmp_path / "spider.json")
+
+        s2 = SpiderScheduler()
+        assert s2.load_from(tmp_path / "spider.json")
+        assert len(s2) == len(s)
+        assert s2.seen == s.seen
+        assert not s2.add_url("http://a.test/1")  # still deduped
+        # remaining queue drains identically
+        assert [d.url for d in sorted(s2.heap)] == \
+               [d.url for d in sorted(s.heap)]
+
+    def test_load_missing_is_false(self, tmp_path):
+        assert not SpiderScheduler().load_from(tmp_path / "nope.json")
+
+
+class TestParmsEndpoint:
+    def test_view_and_live_update(self, tmp_path):
+        from open_source_search_engine_tpu.serve import serve
+        s = serve(tmp_path, port=0)
+        try:
+            base = f"http://127.0.0.1:{s.port}"
+            r = json.load(urllib.request.urlopen(f"{base}/admin/parms"))
+            assert any(row["cgi"] == "langw" for row in r["table"])
+            assert r["coll"]["lang_weight"] == 20.0
+            r = json.load(urllib.request.urlopen(
+                f"{base}/admin/parms?langw=5.5"))
+            assert r["updated"] == {"langw": "5.5"}
+            assert r["coll"]["lang_weight"] == 5.5
+            r = json.load(urllib.request.urlopen(f"{base}/admin/perf"))
+            assert "counters" in r
+        finally:
+            s.stop()

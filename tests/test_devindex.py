@@ -1,0 +1,85 @@
+"""Device-resident index tests — bit-parity with the host-packed path.
+
+The resident kernel reuses score_cube, so any ranking difference means
+the gather/rank/scatter front end diverged from the packer's. Every
+query family must produce identical (docid, score) sets both ways.
+"""
+
+import numpy as np
+import pytest
+
+from open_source_search_engine_tpu.build import docproc
+from open_source_search_engine_tpu.index.collection import Collection
+from open_source_search_engine_tpu.query import engine
+from open_source_search_engine_tpu.query.engine import (
+    get_device_index, search_device, search_device_batch)
+
+DOCS = {
+    "http://a.example.com/fruit": """
+      <html><head><title>Fruit basics</title></head><body>
+      <h1>Apples and bananas</h1>
+      <p>The apple is sweet. A banana is tropical. Apple pie wins.</p>
+      </body></html>""",
+    "http://b.example.com/apple": """
+      <html><head><title>Apple orchard</title></head><body>
+      <p>Our orchard grows apple trees. Apple harvest is in fall.
+      No banana here.</p></body></html>""",
+    "http://c.example.org/banana": """
+      <html><head><title>Banana farm</title></head><body>
+      <p>Banana plantations export banana bunches worldwide.</p>
+      </body></html>""",
+    "http://d.example.org/other": """
+      <html><head><title>Vegetables</title></head><body>
+      <p>Carrots and beets. Root cellar storage tips.</p></body></html>""",
+}
+
+
+@pytest.fixture(scope="module")
+def coll(tmp_path_factory):
+    c = Collection("dev", tmp_path_factory.mktemp("dev"))
+    for u, h in DOCS.items():
+        docproc.index_document(c, u, h)
+    return c
+
+
+QUERIES = ["apple", "banana", "apple banana", "fruit -banana",
+           '"apple pie"', "site:b.example.com apple", "zeppelin"]
+
+
+class TestResidentParity:
+    def test_matches_host_packed_path(self, coll):
+        for q in QUERIES:
+            host = engine.search(coll, q, topk=10, site_cluster=False)
+            dev = search_device(coll, q, topk=10, site_cluster=False)
+            assert dev.total_matches == host.total_matches, q
+            key = lambda r: (-round(r.score, 3), r.docid)
+            assert sorted(map(key, dev.results)) == \
+                   sorted(map(key, host.results)), q
+
+    def test_batch_matches_single(self, coll):
+        batch = search_device_batch(coll, QUERIES, topk=10,
+                                    site_cluster=False)
+        for q, b in zip(QUERIES, batch):
+            s = search_device(coll, q, topk=10, site_cluster=False)
+            assert [r.docid for r in b.results] == \
+                   [r.docid for r in s.results], q
+            np.testing.assert_allclose(
+                [r.score for r in b.results],
+                [r.score for r in s.results], rtol=1e-6)
+
+    def test_refresh_tracks_writes(self, coll):
+        di = get_device_index(coll)
+        v0 = di._built_version
+        assert not search_device(coll, "quokka").results
+        docproc.index_document(
+            coll, "http://e.example.org/q",
+            "<html><title>Q</title><body>a quokka appears</body></html>")
+        res = search_device(coll, "quokka")
+        assert get_device_index(coll)._built_version > v0
+        assert len(res.results) == 1
+        docproc.remove_document(coll, "http://e.example.org/q")
+        assert not search_device(coll, "quokka").results
+
+    def test_empty_collection(self, tmp_path):
+        c = Collection("empty", tmp_path)
+        assert search_device(c, "anything").total_matches == 0

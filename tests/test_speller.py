@@ -1,0 +1,64 @@
+"""Speller tests — dictionary maintenance + did-you-mean suggestions
+(the reference's ``dictlookuptest``/``spellcheck`` CLI tests, SURVEY §4.3)."""
+
+import pytest
+
+from open_source_search_engine_tpu.build import docproc
+from open_source_search_engine_tpu.index.collection import Collection
+from open_source_search_engine_tpu.query import engine
+from open_source_search_engine_tpu.query.speller import (
+    Speller, _edit_distance_le2)
+
+
+class TestEditDistance:
+    @pytest.mark.parametrize("a,b,d", [
+        ("cat", "cat", 0), ("cat", "cut", 1), ("cat", "cats", 1),
+        ("cat", "at", 1), ("kitten", "sitten", 1), ("kitten", "sittin", 2),
+    ])
+    def test_small_distances(self, a, b, d):
+        assert _edit_distance_le2(a, b) == d
+
+    def test_beyond_two_is_none(self):
+        assert _edit_distance_le2("cat", "elephant") is None
+        assert _edit_distance_le2("kitten", "sitting") is None  # d=3
+
+
+class TestSpeller:
+    def test_suggest_popular_neighbor(self, tmp_path):
+        sp = Speller(tmp_path)
+        sp.add_doc_words(["banana"] )
+        sp.add_doc_words(["banana", "apple"])
+        sp.add_doc_words(["banana"])
+        assert sp.suggest_word("bananna") == "banana"
+        assert sp.suggest_word("banana") is None  # already the best
+        assert sp.suggest_word("zzzzqqq") is None
+
+    def test_persistence(self, tmp_path):
+        sp = Speller(tmp_path)
+        sp.add_doc_words(["persistent"])
+        sp.save()
+        sp2 = Speller(tmp_path)
+        assert sp2.counts["persistent"] == 1
+
+    def test_remove(self, tmp_path):
+        sp = Speller(tmp_path)
+        sp.add_doc_words(["gone"])
+        sp.remove_doc_words(["gone"])
+        assert "gone" not in sp.counts
+
+
+class TestDidYouMean:
+    def test_zero_match_query_suggests(self, tmp_path):
+        coll = Collection("sp", tmp_path)
+        for i in range(3):
+            docproc.index_document(
+                coll, f"http://s{i}.test/",
+                "<html><title>Chocolate</title><body>"
+                "<p>chocolate recipes galore</p></body></html>")
+        res = engine.search(coll, "chocolote")
+        assert res.total_matches == 0
+        assert res.suggestion == "chocolate"
+        res = engine.search_device(coll, "chocolote recipes")
+        assert res.suggestion == "chocolate recipes"
+        # matching queries carry no suggestion
+        assert engine.search(coll, "chocolate").suggestion is None
