@@ -443,6 +443,7 @@ class Rdb:
         self._next_run_id += 1
         self.runs.append(run)
         self.mem.clear()
+        self.version += 1  # run set moved: device mirrors must re-base
         # the memtable checkpoint is now stale — drop it so a restart can't
         # resurrect records that live in the freshly dumped run
         saved = self.dir / "saved"
@@ -468,6 +469,7 @@ class Rdb:
         run = Run.write(self.dir / f"run_{self._next_run_id:06d}", merged)
         self._next_run_id += 1
         self.runs = [run]
+        self.version += 1  # run set moved: device mirrors must re-base
         for r in old:
             shutil.rmtree(r.path)
         log.debug("%s: merged %d runs -> %s (%d recs)",

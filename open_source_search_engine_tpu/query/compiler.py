@@ -62,6 +62,11 @@ SUB_ORIGINAL = 0
 SUB_BIGRAM = 1
 SUB_SYNONYM = 2
 
+#: max sublists per term group — each sublist needs at least one slot of
+#: the group's MAX_POSITIONS=16 position budget (packer quota scheme);
+#: the reference caps sublists too (MAX_SUBLISTS, Posdb.h)
+MAX_GROUP_SUBLISTS = 16
+
 
 @dataclass
 class Sublist:
@@ -136,7 +141,8 @@ def compile_query(q: str, lang: int = 0,
                 # phrases it conservatively excludes any adjacent sub-pair
                 # (reference BF_NEGATIVE phrase semantics)
                 subs = [Sublist(ghash.bigram_id(a, b), SUB_BIGRAM, f"{a} {b}")
-                        for a, b in zip(words, words[1:])]
+                        for a, b in zip(words, words[1:])
+                        ][:MAX_GROUP_SUBLISTS]
                 plan.groups.append(TermGroup(
                     display='-"' + " ".join(words) + '"', sublists=subs,
                     negative=True, scored=False, qpos=qpos))

@@ -47,6 +47,20 @@ _SPAM_SHIFT = 27        # wordspamrank: 4 bits
 _SYN_SHIFT = 31         # synonym-ish (scored with SYNONYM_WEIGHT): 1 bit
 
 
+def pack_payload(f: dict[str, np.ndarray], syn: int = 0) -> np.ndarray:
+    """Unpacked posdb fields → the scorer's uint32 payload. The single
+    definition of the payload bit layout (scorer._decode is its inverse);
+    the resident index packs with syn=0 and ORs the query-time synonym
+    flag in-kernel."""
+    return (
+        f["wordpos"].astype(np.uint32) << np.uint32(_POS_SHIFT)
+        | f["hashgroup"].astype(np.uint32) << np.uint32(_HG_SHIFT)
+        | f["densityrank"].astype(np.uint32) << np.uint32(_DEN_SHIFT)
+        | f["wordspamrank"].astype(np.uint32) << np.uint32(_SPAM_SHIFT)
+        | np.uint32(syn) << np.uint32(_SYN_SHIFT)
+    )
+
+
 def _bucket(n: int, floor: int = 8) -> int:
     """Next power of two ≥ n (≥ floor) — static-shape jit buckets."""
     b = floor
@@ -104,45 +118,50 @@ class GroupList:
     payload: np.ndarray    # uint32, parallel
     siterank: np.ndarray   # int32, parallel (per posting, from the key)
     langid: np.ndarray     # int32, parallel
+    sub: np.ndarray        # int32, parallel: originating sublist index
+    n_subs: int = 1        # sublist count (sets the per-sublist quota)
 
 
 def fetch_group_lists(coll: Collection, plan: QueryPlan) -> list[GroupList]:
     """Msg2 equivalent: fetch every group's sublists and mini-merge."""
     out = []
     for g in plan.groups:
-        cols = {"docids": [], "payload": [], "siterank": [], "langid": []}
-        for sub in g.sublists:
+        cols = {"docids": [], "payload": [], "siterank": [], "langid": [],
+                "sub": []}
+        for s_i, sub in enumerate(g.sublists):
             batch = coll.posdb.get_list(posdb.start_key(sub.termid),
                                         posdb.end_key(sub.termid))
             if not len(batch):
                 continue
             f = posdb.unpack(batch.keys)
-            syn = np.uint32(1 if sub.kind == SUB_SYNONYM else 0)
-            payload = (
-                f["wordpos"].astype(np.uint32) << np.uint32(_POS_SHIFT)
-                | f["hashgroup"].astype(np.uint32) << np.uint32(_HG_SHIFT)
-                | f["densityrank"].astype(np.uint32) << np.uint32(_DEN_SHIFT)
-                | f["wordspamrank"].astype(np.uint32) << np.uint32(_SPAM_SHIFT)
-                | syn << np.uint32(_SYN_SHIFT)
-            )
+            payload = pack_payload(
+                f, syn=1 if sub.kind == SUB_SYNONYM else 0)
             cols["docids"].append(f["docid"])
             cols["payload"].append(payload)
             cols["siterank"].append(f["siterank"].astype(np.int32))
             cols["langid"].append(f["langid"].astype(np.int32))
+            cols["sub"].append(np.full(len(batch), s_i, np.int32))
         if cols["docids"]:
             docids = np.concatenate(cols["docids"])
+            # stable sort by docid only: within a doc, postings stay
+            # sublist-major (then wordpos-ascending) — (doc, sublist)
+            # runs are contiguous for the per-sublist slot quota below
             order = np.argsort(docids, kind="stable")
             out.append(GroupList(
                 docids=docids[order],
                 payload=np.concatenate(cols["payload"])[order],
                 siterank=np.concatenate(cols["siterank"])[order],
-                langid=np.concatenate(cols["langid"])[order]))
+                langid=np.concatenate(cols["langid"])[order],
+                sub=np.concatenate(cols["sub"])[order],
+                n_subs=max(len(g.sublists), 1)))
         else:
             out.append(GroupList(
                 docids=np.empty(0, np.uint64),
                 payload=np.empty(0, np.uint32),
                 siterank=np.empty(0, np.int32),
-                langid=np.empty(0, np.int32)))
+                langid=np.empty(0, np.int32),
+                sub=np.empty(0, np.int32),
+                n_subs=max(len(g.sublists), 1)))
     return out
 
 
@@ -252,14 +271,22 @@ def pack_pass(prep: PreparedQuery, doc_offset: int = 0,
         hit = cand[pos_in_cand_c] == gl.docids
         didx = pos_in_cand_c[hit].astype(np.int32)
         payload = gl.payload[hit]
-        # occurrence slot within each (group, doc) run; postings are sorted
-        # by docid then wordpos (posdb key order), so runs are contiguous
+        sub = gl.sub[hit]
+        # per-sublist slot quota within each doc: sublist s owns slots
+        # [s·quota, (s+1)·quota) so a spammy word can never starve its
+        # bigram/synonym siblings out of the position cube (the resident
+        # kernel uses the identical base+rank scheme — parity by
+        # construction). (doc, sublist) runs are contiguous: stable
+        # docid sort keeps sublist-major order within a doc.
         if len(didx):
-            run_start = np.r_[0, np.nonzero(np.diff(didx))[0] + 1]
-            slot = (np.arange(len(didx))
-                    - np.repeat(run_start, np.diff(np.r_[run_start, len(didx)]))
-                    ).astype(np.int32)
-            keep = slot < max_positions
+            quota = max(max_positions // gl.n_subs, 1)
+            n = len(didx)
+            boundary = np.ones(n, bool)
+            boundary[1:] = (didx[1:] != didx[:-1]) | (sub[1:] != sub[:-1])
+            idx = np.arange(n)
+            rank = idx - np.maximum.accumulate(np.where(boundary, idx, 0))
+            slot = (sub * quota + rank).astype(np.int32)
+            keep = (rank < quota) & (slot < max_positions)
             didx, payload, slot = didx[keep], payload[keep], slot[keep]
             max_kept = max(max_kept, len(didx))
         else:

@@ -83,3 +83,164 @@ class TestResidentParity:
     def test_empty_collection(self, tmp_path):
         c = Collection("empty", tmp_path)
         assert search_device(c, "anything").total_matches == 0
+
+    def test_pure_negative_query_matches_host(self, coll):
+        """`-apple` must match NOTHING on both paths (the reference's
+        early-out when no positive required term exists) — the resident
+        path used to match every doc lacking the term."""
+        host = engine.search(coll, "-apple", topk=10)
+        dev = search_device(coll, "-apple", topk=10)
+        assert host.total_matches == 0 and not host.results
+        assert dev.total_matches == 0 and not dev.results
+
+    def test_over_quota_occurrences_keep_sibling_sublists(self, tmp_path):
+        """A doc with more than quota (P//n_sublists) occurrences of a
+        word must not clobber its bigram sublist's slots: over-quota
+        scatter lanes are routed to the drop row (duplicate-index
+        scatter order is implementation-defined on TPU)."""
+        c = Collection("quota", tmp_path)
+        spam = " ".join(["pepper"] * 24) + " pepper mill grinder."
+        docproc.index_document(
+            c, "http://q.example.com/mill",
+            f"<html><head><title>Mill</title></head><body><p>{spam}</p>"
+            "</body></html>")
+        docproc.index_document(
+            c, "http://q.example.com/other",
+            "<html><head><title>Other</title></head><body>"
+            "<p>salt mill only here.</p></body></html>")
+        for q in ["pepper mill", "pepper", '"pepper mill"']:
+            host = engine.search(c, q, topk=10, site_cluster=False)
+            dev = search_device(c, q, topk=10, site_cluster=False)
+            assert dev.total_matches == host.total_matches, q
+            key = lambda r: (-round(r.score, 3), r.docid)
+            assert sorted(map(key, dev.results)) == \
+                   sorted(map(key, host.results)), q
+
+
+class TestScale:
+    """The round-2 scale contract: runs longer than any fixed cap score
+    fully (docid-tile streaming), identical to the host-packed path."""
+
+    def test_large_termlist_no_truncation(self, tmp_path):
+        import numpy as np
+
+        from open_source_search_engine_tpu.index import posdb
+        from open_source_search_engine_tpu.utils import ghash
+
+        c = Collection("big", tmp_path)
+        n = 40_000  # > the old 32768-per-run resident cap
+        docids = np.arange(1, n + 1, dtype=np.uint64)
+        common = ghash.term_id("common")
+        rare = ghash.term_id("rare")
+        keys = [posdb.pack(termid=common, docid=docids, wordpos=5,
+                           densityrank=10, siterank=docids % 15,
+                           hashgroup=0, langid=1)]
+        keys.append(posdb.pack(termid=rare, docid=docids[::200], wordpos=9,
+                               densityrank=10, siterank=docids[::200] % 15,
+                               hashgroup=0, langid=1))
+        c.posdb.add(np.concatenate(keys))
+        c.num_docs = n
+
+        host = engine.search(c, "common rare", topk=10,
+                             with_snippets=False, site_cluster=False)
+        dev = search_device(c, "common rare", topk=10,
+                            with_snippets=False, site_cluster=False)
+        assert host.total_matches == len(docids[::200])
+        assert dev.total_matches == host.total_matches
+        key = lambda r: (-round(r.score, 3), r.docid)
+        assert sorted(map(key, dev.results)) == \
+               sorted(map(key, host.results))
+
+        # single common term: every doc matches, none truncated away
+        host1 = engine.search(c, "common", topk=10, with_snippets=False,
+                              site_cluster=False)
+        dev1 = search_device(c, "common", topk=10, with_snippets=False,
+                             site_cluster=False)
+        assert host1.total_matches == n
+        assert dev1.total_matches == n
+        assert [r.docid for r in dev1.results] == \
+               [r.docid for r in host1.results]
+
+
+class TestIncrementalDelta:
+    """Adds/deletes against a served index cost O(memtable), not
+    O(corpus): the base rebuilds only when the Rdb run set moves."""
+
+    def test_adds_and_deletes_without_full_rebuild(self, tmp_path):
+        c = Collection("inc", tmp_path)
+        for i in range(30):
+            docproc.index_document(
+                c, f"http://inc.test/d{i}",
+                f"<html><head><title>Doc {i}</title></head><body>"
+                f"<p>stable corpus text number{i} here.</p></body></html>")
+        c.posdb.dump()  # base postings now live in a run
+        di = get_device_index(c)
+        base_rebuilds = di.full_rebuilds
+
+        # adds land in the delta: visible immediately, no base rebuild
+        for i in range(3):
+            docproc.index_document(
+                c, f"http://inc.test/new{i}",
+                "<html><head><title>Fresh</title></head><body>"
+                f"<p>freshterm arrives number{i} stable.</p></body></html>")
+            res = search_device(c, "freshterm")
+            assert res.total_matches == i + 1
+        assert di.full_rebuilds == base_rebuilds
+        assert di.delta_rebuilds > 0
+
+        # delete a BASE doc: dead-masked out, still no base rebuild
+        assert docproc.remove_document(c, "http://inc.test/d5")
+        res = search_device(c, "number5")
+        assert all("d5" not in r.url for r in res.results)
+        assert search_device(c, "stable").total_matches == 32
+        assert di.full_rebuilds == base_rebuilds
+
+        # re-index a base doc with new content: old postings dead,
+        # new postings served from the delta
+        docproc.index_document(
+            c, "http://inc.test/d7",
+            "<html><head><title>Doc 7 v2</title></head><body>"
+            "<p>rewrittenterm stable now.</p></body></html>")
+        assert search_device(c, "rewrittenterm").total_matches == 1
+        assert search_device(c, "number7").total_matches == 0
+        assert di.full_rebuilds == base_rebuilds
+
+        # parity with the host path across the mixed base/delta state
+        for q in ["stable", "freshterm", "rewrittenterm", "number12"]:
+            host = engine.search(c, q, topk=10, site_cluster=False)
+            dev = search_device(c, q, topk=10, site_cluster=False)
+            assert dev.total_matches == host.total_matches, q
+            key = lambda r: (-round(r.score, 3), r.docid)
+            assert sorted(map(key, dev.results)) == \
+                   sorted(map(key, host.results)), q
+
+        # a dump moves the run set: exactly one full rebuild folds it
+        c.posdb.dump()
+        search_device(c, "stable")
+        assert di.full_rebuilds == base_rebuilds + 1
+
+    def test_identical_recrawl_no_double_serving(self, tmp_path):
+        """Re-indexing a doc with UNCHANGED content (routine recrawl):
+        the tombstone/positive pairs annihilate inside the memtable, so
+        no tombstone survives — the base copy must still be superseded
+        or the doc serves from both base and delta with doubled df."""
+        c = Collection("recrawl", tmp_path)
+        html = ("<html><head><title>Evergreen</title></head><body>"
+                "<p>evergreen content never changes.</p></body></html>")
+        docproc.index_document(c, "http://re.test/page", html)
+        docproc.index_document(
+            c, "http://re.test/other",
+            "<html><head><title>Other</title></head><body>"
+            "<p>different content here.</p></body></html>")
+        c.posdb.dump()
+        get_device_index(c)
+        # identical re-index: base copy superseded, delta serves
+        docproc.index_document(c, "http://re.test/page", html)
+        host = engine.search(c, "evergreen content", topk=10,
+                             site_cluster=False)
+        dev = search_device(c, "evergreen content", topk=10,
+                            site_cluster=False)
+        assert host.total_matches == 1
+        assert dev.total_matches == 1
+        assert round(dev.results[0].score, 3) == \
+               round(host.results[0].score, 3)
