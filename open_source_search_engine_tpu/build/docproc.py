@@ -39,7 +39,8 @@ from ..utils import ghash
 from ..utils.lang import detect_language
 from ..utils.log import get_logger
 from ..utils.url import normalize
-from .tokenizer import TokenizedDoc, tokenize_html, tokenize_text
+from .tokenizer import (_WORD_RE, TokenizedDoc, tokenize_html,
+                        tokenize_text)
 
 log = get_logger("build")
 
@@ -64,12 +65,15 @@ class MetaList:
 
 
 def _density_ranks(hashgroups: np.ndarray, sentences: np.ndarray) -> np.ndarray:
-    """Vectorized getDensityRanks: per-sentence word counts for body/heading,
+    """Vectorized getDensityRanks: per-sentence word counts for body/
+    heading (and inlink text, where each anchor is its own sentence —
+    the reference runs getDensityRanks over each link text string),
     whole-group counts for the rest."""
     n = len(hashgroups)
     out = np.empty(n, dtype=np.uint64)
     per_sentence = (hashgroups == posdb.HASHGROUP_BODY) | (
-        hashgroups == posdb.HASHGROUP_HEADING)
+        hashgroups == posdb.HASHGROUP_HEADING) | (
+        hashgroups == posdb.HASHGROUP_INLINKTEXT)
     if per_sentence.any():
         sent = sentences[per_sentence]
         uniq, inv, counts = np.unique(sent, return_inverse=True,
@@ -110,29 +114,64 @@ def build_meta_list(
     langid: int | None = None,
     delete: bool = False,
     ts: float | None = None,
+    inlinks: list | None = None,
 ) -> MetaList:
     """Compute every record one document contributes. ``delete=True``
     produces the same records as tombstones (reference: the old doc's
-    meta list with negative keys, ``XmlDoc::getMetaList`` del path)."""
+    meta list with negative keys, ``XmlDoc::getMetaList`` del path).
+
+    ``inlinks`` is the harvested [(anchor text, linker siterank)] list
+    (Msg25 LinkInfo): each anchor's words become HASHGROUP_INLINKTEXT
+    postings with the linker's siterank in the wordspamrank slot
+    (``XmlDoc::hashIncomingLinkText``; LINKER_WEIGHTS applies
+    sqrt(1+siterank), ``Posdb.cpp:1136``). The snapshot is stored in the
+    TitleRec so the delete path regenerates the exact same postings."""
     u = normalize(url)
     docid = ghash.doc_id(u.full)
     tdoc: TokenizedDoc = (tokenize_html(content, u.full) if is_html
                           else tokenize_text(content))
 
-    words = [t.word for t in tdoc.tokens]
-    wordpos = np.array([t.wordpos for t in tdoc.tokens], dtype=np.uint64)
-    hashgroups = np.array([t.hashgroup for t in tdoc.tokens], dtype=np.uint64)
-    sentences = np.array([t.sentence_id for t in tdoc.tokens], dtype=np.uint64)
+    doc_words = [t.word for t in tdoc.tokens]
+    words = list(doc_words)
+    wp_list = [t.wordpos for t in tdoc.tokens]
+    hg_list = [t.hashgroup for t in tdoc.tokens]
+    sent_list = [t.sentence_id for t in tdoc.tokens]
 
     if langid is None:
-        langid = detect_language(words)
+        langid = detect_language(doc_words)
+
+    # inlink anchor tokens: each anchor is its own sentence, in its own
+    # position neighborhood (gaps > NONBODY_DIST_CAP=50 so words of
+    # different anchors never look adjacent to pair scoring)
+    inlinks = [(t, int(sr)) for t, sr in (inlinks or []) if t]
+    il_spam: list[int] = []
+    if inlinks:
+        pos0 = (max(wp_list) if wp_list else 0) + 100
+        sent0 = (max(sent_list) if sent_list else 0) + 1
+        for j, (text, linker_sr) in enumerate(inlinks):
+            aw = [w.lower() for w in _WORD_RE.findall(text)][:64]
+            for i, w in enumerate(aw):
+                words.append(w)
+                wp_list.append(min(pos0 + i, posdb.MAXWORDPOS))
+                hg_list.append(posdb.HASHGROUP_INLINKTEXT)
+                sent_list.append(sent0 + j)
+                il_spam.append(min(max(linker_sr, 0),
+                                   posdb.MAXWORDSPAMRANK))
+            pos0 += len(aw) + 100
+
+    wordpos = np.array(wp_list, dtype=np.uint64)
+    hashgroups = np.array(hg_list, dtype=np.uint64)
+    sentences = np.array(sent_list, dtype=np.uint64)
 
     delbit = 0 if delete else 1
 
     if len(words):
         termids = np.array([ghash.term_id(w) for w in words], dtype=np.uint64)
         density = _density_ranks(hashgroups, sentences)
-        spam = _spam_ranks(words)
+        spam = np.concatenate([
+            _spam_ranks(doc_words),
+            np.array(il_spam, dtype=np.uint64)]) if il_spam \
+            else _spam_ranks(doc_words)
         keys = [posdb.pack(
             termid=termids, docid=docid, wordpos=wordpos,
             densityrank=density, wordspamrank=spam, siterank=siterank,
@@ -187,7 +226,8 @@ def build_meta_list(
             content_hash=content_hash,
             ts=ts if ts is not None else time.time(),
             extra={"content": content, "is_html": is_html,
-                   "meta_description": tdoc.meta_description},
+                   "meta_description": tdoc.meta_description,
+                   "inlinks": [[t, sr] for t, sr in inlinks]},
         )
     sitehash = ghash.hash64(u.site) & ((1 << clusterdb.SITEHASH_BITS) - 1)
     return MetaList(
@@ -199,29 +239,132 @@ def build_meta_list(
         links=tdoc.links,
         langid=langid,
         site=u.site,
-        words=words,
+        words=doc_words,
     )
+
+
+def absolutize(base: str, href: str) -> str | None:
+    """Resolve an outlink href against its page URL (skip non-http)."""
+    from urllib.parse import urldefrag, urljoin
+    if href.startswith(("javascript:", "mailto:", "#")):
+        return None
+    absu = urldefrag(urljoin(base, href))[0] or None
+    if absu and not absu.startswith(("http://", "https://")):
+        return None
+    return absu
+
+
+def outlink_edges(ml: MetaList, linker_url: str):
+    """Normalized (linkee, anchor) pairs for a meta list's outlinks —
+    the linkdb records the reference's meta list carries."""
+    out = []
+    for href, anchor in ml.links:
+        absu = absolutize(linker_url, href)
+        if not absu:
+            continue
+        try:
+            linkee = normalize(absu)
+        except Exception:  # noqa: BLE001 — junk hrefs abound
+            continue
+        out.append((linkee, anchor))
+    return out
+
+
+def needs_link_refresh(fresh: list, stored: list) -> bool:
+    """Should a linkee reindex to pick up its changed anchor set?
+    Removals and changes always refresh (a stale weight-16 signal is
+    worse than a missing one); growth refreshes exactly while small,
+    then on doublings — the reference's deferred LinkInfo update
+    interval, made deterministic, bounding hub-page reindexes to
+    O(log inlinkers) during a crawl."""
+    if sorted(fresh) == sorted(stored):
+        return False
+    if len(fresh) <= len(stored):
+        return True
+    if len(stored) < 8:
+        return True
+    return len(fresh) >= 2 * len(stored)
+
+
+def refresh_linkees(linkees, own_site: str, *, get_doc, linkdb_of,
+                    reindex) -> None:
+    """Shared propagate step (single-node and sharded flows): for each
+    external linkee already indexed, compare its stored inlink snapshot
+    with a fresh harvest and reindex when stale."""
+    seen: set[str] = set()
+    for linkee in linkees:
+        if linkee.site == own_site or linkee.full in seen:
+            continue
+        seen.add(linkee.full)
+        rec = get_doc(linkee)
+        if rec is None:
+            continue
+        fresh = linkdb_of(linkee.site).inlinks_for_url(linkee.site,
+                                                       linkee.full)
+        stored = [tuple(x) for x in rec.get("inlinks") or []]
+        if needs_link_refresh(fresh, stored):
+            reindex(linkee, rec)
 
 
 def index_document(coll: Collection, url: str, content: str, *,
                    is_html: bool = True, siterank: int = 0,
-                   langid: int | None = None) -> MetaList:
+                   langid: int | None = None,
+                   propagate: bool = True) -> MetaList:
     """Index (or re-index) one document into a collection — the
-    ``XmlDoc::indexDoc`` flow: tombstone the old version if present, add
-    the new records, bump counters."""
-    old = remove_document(coll, url, _count=False)
+    ``XmlDoc::indexDoc`` flow: tombstone the old version if present,
+    harvest this URL's inlink anchor text from linkdb (Msg25 LinkInfo),
+    add the new records, record outlink edges, and re-index any already-
+    indexed linkee whose anchor set changed — including linkees the OLD
+    version linked to and the new one doesn't (their anchor goes away)."""
+    old = remove_document(coll, url, _count=False, propagate=False)
+    u = normalize(url)
+    inlinks = coll.linkdb.inlinks_for_url(u.site, u.full)
     ml = build_meta_list(url, content, is_html=is_html, siterank=siterank,
-                         langid=langid)
+                         langid=langid, inlinks=inlinks)
     coll.posdb.add(ml.posdb_keys)
     coll.titledb.add(ml.titledb_key.reshape(1), [ml.title_rec])
     coll.clusterdb.add(ml.clusterdb_key.reshape(1))
     coll.titlerec_cache.pop(ml.docid, None)
     if ml.words:
         coll.speller.add_doc_words(ml.words)
-    if not old:
+    if old is None:
         coll.doc_added()
-    log.debug("indexed %s docid=%d keys=%d", url, ml.docid, len(ml.posdb_keys))
+    # record outlink edges with anchor text (this page's siterank is the
+    # linker rank riding each edge), then refresh affected linkees:
+    # the new edge set plus any former linkees whose edge was tombstoned
+    edges = outlink_edges(ml, u.full)
+    for linkee, anchor in edges:
+        coll.linkdb.add_link(linkee.site, u.site, u.full,
+                             linkee_url=linkee.full, anchor_text=anchor,
+                             linker_siterank=siterank)
+    if propagate:
+        affected = [e[0] for e in edges]
+        if old is not None:
+            affected += [e[0] for e in outlink_edges(old, u.full)]
+        refresh_linkees(
+            affected, u.site,
+            get_doc=lambda lk: get_document(coll, url=lk.full),
+            linkdb_of=lambda _site: coll.linkdb,
+            reindex=lambda lk, rec: reindex_document(coll, lk.full))
+    log.debug("indexed %s docid=%d keys=%d inlinks=%d", url, ml.docid,
+              len(ml.posdb_keys), len(inlinks))
     return ml
+
+
+def reindex_document(coll: Collection, url: str) -> MetaList | None:
+    """Re-index a document from its stored content — fresh inlink
+    harvest + recomputed link-derived siterank (the reference's reindex
+    path, ``Repair.cpp``/``PageReindex`` semantics)."""
+    from ..spider.linkdb import site_rank
+    rec = get_document(coll, url=url)
+    if rec is None:
+        return None
+    u = normalize(url)
+    return index_document(
+        coll, url, rec.get("content", rec["text"]),
+        is_html=rec.get("is_html", True),
+        siterank=site_rank(coll.linkdb.site_num_inlinks(u.site)),
+        langid=rec.get("langid"))
 
 
 def tombstone_meta_list(rec: dict) -> MetaList:
@@ -234,13 +377,17 @@ def tombstone_meta_list(rec: dict) -> MetaList:
                            is_html=rec.get("is_html", True),
                            siterank=rec.get("siterank", 0),
                            langid=rec.get("langid"), delete=True,
-                           ts=rec.get("ts"))
+                           ts=rec.get("ts"),
+                           inlinks=[tuple(x) for x in
+                                    rec.get("inlinks") or []])
 
 
-def remove_document(coll: Collection, url: str, _count: bool = True) -> bool:
+def remove_document(coll: Collection, url: str, _count: bool = True,
+                    propagate: bool = True) -> MetaList | None:
     """Delete a document: regenerate its records from the stored TitleRec
     content and add them as tombstones (the reference's reindex/del path
-    regenerates the old meta list the same way)."""
+    regenerates the old meta list the same way). Returns the tombstone
+    meta list (truthy) so re-index callers can diff old/new edge sets."""
     u = normalize(url)
     docid = ghash.doc_id(u.full)
     existing = coll.titledb.get_list(titledb.start_key(docid),
@@ -252,18 +399,34 @@ def remove_document(coll: Collection, url: str, _count: bool = True) -> bool:
         titledb.unpack_key(existing.keys)["urlhash32"] == np.uint64(want)
     )[0] if len(existing) else np.empty(0, dtype=np.int64)
     if not len(match):
-        return False
+        return None
     rec = titledb.read_title_rec(existing.payload(int(match[-1])))
     ml = tombstone_meta_list(rec)
     coll.posdb.add(ml.posdb_keys)
     coll.titledb.add(ml.titledb_key.reshape(1), [b""])
     coll.clusterdb.add(ml.clusterdb_key.reshape(1))
     coll.titlerec_cache.pop(ml.docid, None)
+    # tombstone this page's outlink edges so its anchors stop feeding
+    # linkee rankings (the old meta list's linkdb records, negated)
+    from ..spider.linkdb import pack_key as link_key
+    edges = outlink_edges(ml, u.full)
+    for linkee, _anchor in edges:
+        if linkee.site == u.site:
+            continue
+        coll.linkdb.rdb.delete(
+            link_key(linkee.site, linkee.full, u.site, u.full).reshape(1))
     if ml.words:
         coll.speller.remove_doc_words(ml.words)
     if _count:
         coll.doc_removed()
-    return True
+    if propagate:
+        # former linkees lose this page's anchor — refresh them
+        refresh_linkees(
+            [e[0] for e in edges], u.site,
+            get_doc=lambda lk: get_document(coll, url=lk.full),
+            linkdb_of=lambda _site: coll.linkdb,
+            reindex=lambda lk, _rec: reindex_document(coll, lk.full))
+    return ml
 
 
 def get_document(coll: Collection, url: str | None = None,

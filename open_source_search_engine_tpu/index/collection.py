@@ -31,6 +31,8 @@ class Collection:
                                    has_data=True)
         self.clusterdb = rdblite.Rdb("clusterdb", self.dir,
                                      clusterdb.KEY_DTYPE)
+        from ..spider.linkdb import Linkdb
+        self.linkdb = Linkdb(self.dir)
         from ..query.speller import Speller
         self.speller = Speller(self.dir)
         self._stats_path = self.dir / "collstats.json"
@@ -60,13 +62,15 @@ class Collection:
     # --- lifecycle (Process::saveRdbTrees equivalent) ---
 
     def save(self) -> None:
-        for db in (self.posdb, self.titledb, self.clusterdb):
+        for db in (self.posdb, self.titledb, self.clusterdb,
+                   self.linkdb.rdb):
             db.save()
         self.speller.save()
         self._save_stats()
 
     def dump_all(self) -> None:
-        for db in (self.posdb, self.titledb, self.clusterdb):
+        for db in (self.posdb, self.titledb, self.clusterdb,
+                   self.linkdb.rdb):
             db.dump()
         self._save_stats()
 

@@ -72,6 +72,16 @@ class HostMap:
     def shard_of_keys(self, keys: np.ndarray) -> np.ndarray:
         return posdb.shard_of_keys(keys, self.n_shards)
 
+    def shard_of_site(self, site: str) -> int:
+        """Linkdb routing: records shard by LINKEE SITE hash so site
+        inlink counts and anchor harvests are single-shard reads
+        (reference ``getShardNum(RDB_LINKDB)`` keys by linkee site,
+        ``Hostdb.cpp:~2514``)."""
+        from ..utils import ghash
+        return int(ghash.hash64_array(
+            np.asarray([ghash.hash64(site)], np.uint64))[0]
+            % np.uint64(self.n_shards))
+
     def mark_dead(self, shard: int) -> None:
         """PingServer dead-host marking (``PingServer.h:61``)."""
         self.alive[shard] = False

@@ -49,6 +49,12 @@ from .hostmap import SHARD_AXIS, HostMap, make_mesh
 log = get_logger("parallel")
 
 
+def _docid_of(url: str) -> int:
+    from ..utils import ghash
+    from ..utils.url import normalize
+    return ghash.doc_id(normalize(url).full)
+
+
 class ShardedCollection:
     """One logical collection partitioned across N shards.
 
@@ -76,14 +82,28 @@ class ShardedCollection:
 
     # --- build plane: route records by shard (Msg4 / Msg1 semantics) ---
 
+    def _linkdb_of(self, site: str):
+        """The shard owning a site's linkdb records (linkee-site routed,
+        like the reference's RDB_LINKDB shard map)."""
+        return self.shards[self.hostmap.shard_of_site(site)].linkdb
+
+    def site_num_inlinks(self, site: str) -> int:
+        return self._linkdb_of(site).site_num_inlinks(site)
+
     def index_document(self, url: str, content: str, *, is_html: bool = True,
-                       siterank: int = 0, langid: int | None = None):
+                       siterank: int = 0, langid: int | None = None,
+                       propagate: bool = True):
         """Index one document, scattering its records to owning shards
         (the reference's Msg4 meta-list add: posdb keys split by docid/
-        termid shard, titledb+clusterdb to the docid's shard)."""
-        self.remove_document(url)
+        termid shard, titledb+clusterdb to the docid's shard, linkdb
+        edges to the linkee site's shard)."""
+        from ..utils.url import normalize
+        old = self.remove_document(url, propagate=False)
+        u = normalize(url)
+        inlinks = self._linkdb_of(u.site).inlinks_for_url(u.site, u.full)
         ml = docproc.build_meta_list(url, content, is_html=is_html,
-                                     siterank=siterank, langid=langid)
+                                     siterank=siterank, langid=langid,
+                                     inlinks=inlinks)
         home = int(self.hostmap.shard_of_docid(ml.docid))
         key_shards = self.hostmap.shard_of_keys(ml.posdb_keys)
         for s in np.unique(key_shards):
@@ -93,16 +113,41 @@ class ShardedCollection:
         coll.clusterdb.add(ml.clusterdb_key.reshape(1))
         coll.titlerec_cache.pop(ml.docid, None)
         coll.doc_added()
+        # outlink edges → linkee-site shards; refresh affected linkees
+        # (shared propagate step, including the old version's linkees)
+        edges = docproc.outlink_edges(ml, u.full)
+        for linkee, anchor in edges:
+            self._linkdb_of(linkee.site).add_link(
+                linkee.site, u.site, u.full, linkee_url=linkee.full,
+                anchor_text=anchor, linker_siterank=siterank)
+        if propagate:
+            affected = [e[0] for e in edges]
+            if old:
+                affected += [e[0] for e in
+                             docproc.outlink_edges(old, u.full)]
+            self._refresh_linkees(affected, u.site)
         return ml
 
-    def remove_document(self, url: str) -> bool:
+    def _refresh_linkees(self, linkees, own_site: str) -> None:
+        from ..spider.linkdb import site_rank
+        docproc.refresh_linkees(
+            linkees, own_site,
+            get_doc=lambda lk: self.get_document(_docid_of(lk.full)),
+            linkdb_of=self._linkdb_of,
+            reindex=lambda lk, rec: self.index_document(
+                lk.full, rec.get("content", rec["text"]),
+                is_html=rec.get("is_html", True),
+                siterank=site_rank(self.site_num_inlinks(lk.site)),
+                langid=rec.get("langid")))
+
+    def remove_document(self, url: str, propagate: bool = True):
+        from ..spider.linkdb import pack_key as link_key
         from ..utils.url import normalize
-        from ..utils import ghash
-        docid = ghash.doc_id(normalize(url).full)
+        docid = _docid_of(url)
         home = int(self.hostmap.shard_of_docid(docid))
         ml = docproc.get_document(self.shards[home], url=url)
         if ml is None:
-            return False
+            return None
         # regenerate tombstones and scatter them the same way
         dead = docproc.tombstone_meta_list(ml)
         key_shards = self.hostmap.shard_of_keys(dead.posdb_keys)
@@ -112,8 +157,18 @@ class ShardedCollection:
         coll.titledb.add(dead.titledb_key.reshape(1), [b""])
         coll.clusterdb.add(dead.clusterdb_key.reshape(1))
         coll.titlerec_cache.pop(dead.docid, None)
+        u = normalize(url)
+        edges = docproc.outlink_edges(dead, u.full)
+        for linkee, _anchor in edges:
+            if linkee.site == u.site:
+                continue
+            self._linkdb_of(linkee.site).rdb.delete(
+                link_key(linkee.site, linkee.full, u.site,
+                         u.full).reshape(1))
         coll.doc_removed()
-        return True
+        if propagate:
+            self._refresh_linkees([e[0] for e in edges], u.site)
+        return dead
 
     def get_document(self, docid: int) -> dict | None:
         """Msg22 titlerec fetch from the owning shard."""

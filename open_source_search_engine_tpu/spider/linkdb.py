@@ -1,47 +1,59 @@
-"""Linkdb — the link graph store feeding siteNumInlinks/siterank.
+"""Linkdb — the link graph store: site quality AND inlink anchor text.
 
 Reference: ``Linkdb.{h,cpp}`` — inlink records keyed by linkee site/url
-hash (``Linkdb.h:166``), harvested at index time, aggregated by Msg25
-into LinkInfo whose ``m_numGoodInlinks`` drives the site quality rank via
-``getSiteRank(sni)`` (``Linkdb.cpp:7110`` — a step table, reproduced in
-:func:`site_rank`). Link-text itself rides into posdb as
-HASHGROUP_INLINKTEXT postings during the linker's indexing.
+hash (``Linkdb.h:166``), harvested at index time and aggregated by Msg25
+into LinkInfo (``Linkdb.h:424``): the distinct-linker-site count
+("good inlinks") drives site quality via ``getSiteRank(sni)``
+(``Linkdb.cpp:7110`` step table, :func:`site_rank`), and the inlink
+*text* is hashed into the linkee's posdb postings at
+``HASHGROUP_INLINKTEXT`` with the linker's siterank riding the
+wordspamrank slot (``XmlDoc::hashIncomingLinkText``,
+``XmlDoc.cpp:28957`` hashAll; weights ``Posdb.cpp:1105,1136``) — the
+reference's strongest ranking signal.
 
-Keys here: (linkee site hash 32, linker site hash 32, linker url hash 32)
-dataless — one record per (linking page → linked site) edge; distinct
-linker-site count = "good inlinks" (the reference dedups inlinks per
-linking site/IP the same way).
+Keys: (linkee site hash 32 | linkee url hash 32) in n1, (linker site
+hash 32 | linker url hash 31 | delbit) in n0 — sorted by linkee site
+then linkee url, so both the site-level inlink count and the url-level
+anchor harvest are single range reads. Payload: the anchor text + the
+linker's siterank at link time (JSON).
 """
 
 from __future__ import annotations
+
+import json
 
 import numpy as np
 
 from ..index import rdblite
 from ..utils import ghash
 
-KEY_DTYPE = np.dtype([("n0", "<u4"), ("n1", "<u8")], align=False)
-# n1 = linkee_sitehash32 << 32 | linker_sitehash32 ; n0 = linkerurl31 | delbit
+KEY_DTYPE = np.dtype([("n0", "<u8"), ("n1", "<u8")], align=False)
+
+#: cap on harvested anchors per linkee (reference caps LinkInfo inlinks;
+#: MAX_LINKERS-style bound keeps the posting count per doc sane)
+MAX_INLINKS = 128
 
 
-def pack_key(linkee_site: str, linker_site: str, linker_url: str,
-             delbit: int = 1) -> np.ndarray:
-    n1 = ((ghash.hash64(linkee_site) & 0xFFFFFFFF) << 32) \
-        | (ghash.hash64(linker_site) & 0xFFFFFFFF)
-    n0 = ((ghash.hash64(linker_url) & 0x7FFFFFFF) << 1) | (delbit & 1)
+def _h32(s: str) -> int:
+    return ghash.hash64(s) & 0xFFFFFFFF
+
+
+def pack_key(linkee_site: str, linkee_url: str, linker_site: str,
+             linker_url: str, delbit: int = 1) -> np.ndarray:
     k = np.zeros((), dtype=KEY_DTYPE)
-    k["n1"] = np.uint64(n1)
-    k["n0"] = np.uint32(n0)
+    k["n1"] = np.uint64((_h32(linkee_site) << 32) | _h32(linkee_url))
+    k["n0"] = np.uint64((_h32(linker_site) << 32)
+                        | ((ghash.hash64(linker_url) & 0x7FFFFFFF) << 1)
+                        | (delbit & 1))
     return k
 
 
-def _site_range(linkee_site: str) -> tuple[np.ndarray, np.ndarray]:
-    h = ghash.hash64(linkee_site) & 0xFFFFFFFF
+def _range(n1_lo: int, n1_hi: int) -> tuple[np.ndarray, np.ndarray]:
     lo = np.zeros((), dtype=KEY_DTYPE)
-    lo["n1"] = np.uint64(h << 32)
+    lo["n1"] = np.uint64(n1_lo)
     hi = np.zeros((), dtype=KEY_DTYPE)
-    hi["n1"] = np.uint64((h << 32) | 0xFFFFFFFF)
-    hi["n0"] = np.uint32(0xFFFFFFFF)
+    hi["n1"] = np.uint64(n1_hi)
+    hi["n0"] = np.uint64(0xFFFFFFFFFFFFFFFF)
     return lo, hi
 
 
@@ -49,23 +61,56 @@ class Linkdb:
     """Per-node link graph database (an Rdb instance like the others)."""
 
     def __init__(self, directory):
-        self.rdb = rdblite.Rdb("linkdb", directory, KEY_DTYPE)
+        self.rdb = rdblite.Rdb("linkdb", directory, KEY_DTYPE,
+                               has_data=True)
 
     def add_link(self, linkee_site: str, linker_site: str,
-                 linker_url: str) -> None:
+                 linker_url: str, linkee_url: str = "",
+                 anchor_text: str = "", linker_siterank: int = 0) -> None:
+        """Record one (linking page → linked page) edge with its anchor
+        text (the linkdb record the reference's meta list carries)."""
         if linkee_site == linker_site:
             return  # internal links don't count toward site quality
-        self.rdb.add(pack_key(linkee_site, linker_site,
-                              linker_url).reshape(1))
+        payload = json.dumps(
+            {"t": anchor_text[:512], "sr": int(linker_siterank)},
+            separators=(",", ":")).encode()
+        self.rdb.add(pack_key(linkee_site, linkee_url, linker_site,
+                              linker_url).reshape(1), [payload])
 
     def site_num_inlinks(self, site: str) -> int:
         """Distinct linking sites (the 'good inlinks' count Msg25 yields)."""
-        lo, hi = _site_range(site)
-        batch = self.rdb.get_list(lo, hi)
+        h = _h32(site)
+        batch = self.rdb.get_list(*_range(h << 32, (h << 32) | 0xFFFFFFFF))
         if not len(batch):
             return 0
-        linker_sites = np.asarray(batch.keys["n1"]) & np.uint64(0xFFFFFFFF)
+        linker_sites = np.asarray(batch.keys["n0"]) >> np.uint64(32)
         return int(len(np.unique(linker_sites)))
+
+    def inlinks_for_url(self, linkee_site: str, linkee_url: str
+                        ) -> list[tuple[str, int]]:
+        """[(anchor text, linker siterank)] for one linkee URL, one vote
+        per linking site (Msg25 dedups inlinks per site), capped at
+        MAX_INLINKS — the LinkInfo harvest that feeds
+        ``hashIncomingLinkText``."""
+        n1 = (_h32(linkee_site) << 32) | _h32(linkee_url)
+        batch = self.rdb.get_list(*_range(n1, n1))
+        out: list[tuple[str, int]] = []
+        seen_sites: set[int] = set()
+        for i in range(len(batch)):
+            linker_site = int(batch.keys["n0"][i] >> np.uint64(32))
+            if linker_site in seen_sites:
+                continue
+            try:
+                rec = json.loads(batch.payload(i))
+            except (ValueError, UnicodeDecodeError):
+                continue
+            if not rec.get("t"):
+                continue  # empty anchors contribute nothing to text
+            seen_sites.add(linker_site)
+            out.append((rec["t"], int(rec.get("sr", 0))))
+            if len(out) >= MAX_INLINKS:
+                break
+        return out
 
     def save(self) -> None:
         self.rdb.save()

@@ -21,7 +21,7 @@ from ..build import docproc
 from ..utils.log import get_logger
 from ..utils.url import normalize
 from .fetcher import Fetcher
-from .linkdb import Linkdb, site_rank
+from .linkdb import site_rank
 from .scheduler import SpiderScheduler
 
 log = get_logger("spider")
@@ -42,24 +42,27 @@ class SpiderLoop:
 
     def __init__(self, coll_or_sharded, scheduler: SpiderScheduler | None
                  = None, fetcher: Fetcher | None = None,
-                 linkdb: Linkdb | None = None, batch_size: int = 8):
+                 batch_size: int = 8):
         self.target = coll_or_sharded
         self.sched = scheduler or SpiderScheduler()
         self.fetcher = fetcher or Fetcher()
-        ldir = getattr(coll_or_sharded, "dir", None) or \
-            getattr(coll_or_sharded, "base_dir")
-        self.linkdb = linkdb or Linkdb(ldir)
         self.batch_size = batch_size
         self.stats = CrawlStats()
 
     def add_url(self, url: str) -> bool:
         return self.sched.add_url(url)
 
+    def _site_num_inlinks(self, site: str) -> int:
+        if hasattr(self.target, "site_num_inlinks"):  # ShardedCollection
+            return self.target.site_num_inlinks(site)
+        return self.target.linkdb.site_num_inlinks(site)
+
     def _index(self, url: str, content: str, is_html: bool):
         """Index one page; returns the MetaList (whose .links carries the
-        outlinks from the same tokenize pass — no reparse needed)."""
+        outlinks from the same tokenize pass — no reparse needed). The
+        indexer itself records linkdb edges + inlink-text postings."""
         site = normalize(url).site
-        sr = site_rank(self.linkdb.site_num_inlinks(site))
+        sr = site_rank(self._site_num_inlinks(site))
         if hasattr(self.target, "index_document"):  # ShardedCollection
             return self.target.index_document(url, content,
                                               is_html=is_html, siterank=sr)
@@ -93,27 +96,15 @@ class SpiderLoop:
                 self.stats.errors += 1
                 log.warning("index failed %s: %s", req.url, e)
                 continue
-            # harvest outlinks: enqueue + record link edges
+            # enqueue outlinks (edges were recorded by the indexer)
             linker = normalize(res.url)
             for href, _anchor in (ml.links if res.is_html else []):
-                absu = self._absolutize(linker.full, href)
+                absu = docproc.absolutize(linker.full, href)
                 if not absu:
                     continue
                 self.stats.links_found += 1
-                try:
-                    linkee = normalize(absu)
-                except Exception:
-                    continue
-                self.linkdb.add_link(linkee.site, linker.site, linker.full)
                 self.sched.add_url(absu, hopcount=req.hopcount + 1)
         return indexed
-
-    @staticmethod
-    def _absolutize(base: str, href: str) -> str | None:
-        from urllib.parse import urljoin, urldefrag
-        if href.startswith(("javascript:", "mailto:", "#")):
-            return None
-        return urldefrag(urljoin(base, href))[0] or None
 
     def crawl(self, max_pages: int = 100, max_steps: int | None = None
               ) -> CrawlStats:

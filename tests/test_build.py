@@ -9,6 +9,7 @@ from open_source_search_engine_tpu.build import docproc
 from open_source_search_engine_tpu.build.tokenizer import tokenize_html
 from open_source_search_engine_tpu.index import posdb, titledb
 from open_source_search_engine_tpu.index.collection import Collection
+from open_source_search_engine_tpu.query import engine
 from open_source_search_engine_tpu.utils import ghash
 from open_source_search_engine_tpu.utils.lang import LANG_ENGLISH, LANG_GERMAN, detect_language
 
@@ -159,3 +160,101 @@ class TestIndexDocument:
         tid = ghash.term_id("tiger")
         assert len(coll2.posdb.get_list(posdb.start_key(tid),
                                         posdb.end_key(tid))) > 0
+
+
+class TestInlinkText:
+    """Inlink anchor-text ranking — the reference's strongest signal
+    (XmlDoc::hashIncomingLinkText, HASHGROUP_INLINKTEXT weight 16.0 with
+    LINKER_WEIGHTS on the linker's siterank, Posdb.cpp:1105,1136)."""
+
+    LINKEE = "http://target.example.com/widgets"
+    LINKEE_HTML = ("<html><head><title>Products</title></head><body>"
+                   "<p>our catalog page lists many products.</p>"
+                   "</body></html>")
+    DECOY = "http://decoy.example.com/frob"
+    DECOY_HTML = ("<html><head><title>Frobnicator</title></head><body>"
+                  "<p>frobnicator mentioned once in passing text body "
+                  "somewhere deep.</p></body></html>")
+    LINKER = "http://blog.example.org/post"
+    LINKER_HTML = ("<html><head><title>Blog</title></head><body>"
+                   "<p>check out this <a href="
+                   "\"http://target.example.com/widgets\">frobnicator "
+                   "deluxe</a> thing.</p></body></html>")
+
+    def test_anchor_only_term_ranks_first(self, tmp_path):
+        """'frobnicator' appears in the linkee ONLY via its inlink
+        anchor — yet the linkee must outrank a page containing the word
+        in its body (inlink weight 16 vs body 1)."""
+        c = Collection("il1", tmp_path)
+        docproc.index_document(c, self.LINKEE, self.LINKEE_HTML)
+        docproc.index_document(c, self.DECOY, self.DECOY_HTML)
+        docproc.index_document(c, self.LINKER, self.LINKER_HTML,
+                               siterank=8)
+        res = engine.search(c, "frobnicator", site_cluster=False)
+        urls = [r.url for r in res.results]
+        assert self.LINKEE in urls  # linker indexed AFTER linkee: reindex
+        assert urls[0] == self.LINKEE
+        # the linker page itself also matches (anchor is body text there)
+        assert res.total_matches >= 2
+
+    def test_linker_first_order_independence(self, tmp_path):
+        """Linker crawled BEFORE the linkee: the harvest at linkee index
+        time picks the anchor up — same ranking either way."""
+        c = Collection("il2", tmp_path)
+        docproc.index_document(c, self.LINKER, self.LINKER_HTML,
+                               siterank=8)
+        docproc.index_document(c, self.DECOY, self.DECOY_HTML)
+        docproc.index_document(c, self.LINKEE, self.LINKEE_HTML)
+        res = engine.search(c, "frobnicator", site_cluster=False)
+        assert res.results[0].url == self.LINKEE
+
+    def test_delete_linker_removes_anchor_signal(self, tmp_path):
+        """Deleting the linker propagates: the linkee reindexes on its
+        own and loses the weight-16 anchor postings (no manual refresh)."""
+        c = Collection("il3", tmp_path)
+        docproc.index_document(c, self.LINKEE, self.LINKEE_HTML)
+        docproc.index_document(c, self.LINKER, self.LINKER_HTML)
+        assert any(r.url == self.LINKEE for r in
+                   engine.search(c, "frobnicator").results)
+        docproc.remove_document(c, self.LINKER)
+        res = engine.search(c, "frobnicator")
+        assert not any(r.url == self.LINKEE for r in res.results)
+
+    def test_recrawled_linker_dropping_link_removes_signal(self, tmp_path):
+        """The linker is re-indexed WITHOUT the link: its old edge is
+        tombstoned and the former linkee must stop ranking for the
+        anchor-only term."""
+        c = Collection("il6", tmp_path)
+        docproc.index_document(c, self.LINKEE, self.LINKEE_HTML)
+        docproc.index_document(c, self.LINKER, self.LINKER_HTML)
+        assert engine.search(c, "frobnicator").results[0].url == self.LINKEE
+        docproc.index_document(
+            c, self.LINKER,
+            "<html><head><title>Blog</title></head><body>"
+            "<p>nothing linked here any more.</p></body></html>")
+        res = engine.search(c, "frobnicator")
+        assert not any(r.url == self.LINKEE for r in res.results)
+
+    def test_resident_path_parity_with_inlinks(self, tmp_path):
+        from open_source_search_engine_tpu.query.engine import search_device
+        c = Collection("il4", tmp_path)
+        docproc.index_document(c, self.LINKEE, self.LINKEE_HTML)
+        docproc.index_document(c, self.DECOY, self.DECOY_HTML)
+        docproc.index_document(c, self.LINKER, self.LINKER_HTML,
+                               siterank=8)
+        host = engine.search(c, "frobnicator", site_cluster=False)
+        dev = search_device(c, "frobnicator", site_cluster=False)
+        assert dev.total_matches == host.total_matches
+        key = lambda r: (-round(r.score, 3), r.docid)
+        assert sorted(map(key, dev.results)) == \
+               sorted(map(key, host.results))
+
+    def test_sharded_inlink_ranking(self, tmp_path):
+        from open_source_search_engine_tpu.parallel import (
+            ShardedCollection, sharded_search)
+        sc = ShardedCollection("il5", tmp_path, n_shards=4)
+        sc.index_document(self.LINKEE, self.LINKEE_HTML)
+        sc.index_document(self.DECOY, self.DECOY_HTML)
+        sc.index_document(self.LINKER, self.LINKER_HTML, siterank=8)
+        res = sharded_search(sc, "frobnicator", site_cluster=False)
+        assert res.results and res.results[0].url == self.LINKEE
