@@ -55,16 +55,18 @@ class HostMap:
     """Topology record: shard count, replication, and key routing.
 
     The reference's ``hosts.conf`` distilled: ``index-splits:`` →
-    ``n_shards``, ``num-mirrors:`` → ``n_replicas - 1``.
+    ``n_shards``, ``num-mirrors:`` → ``n_replicas - 1``. Liveness is
+    per (shard, replica) — the reference's per-host ping state.
     """
 
     n_shards: int
     n_replicas: int = 1
-    alive: np.ndarray = field(default=None)  # bool [n_shards] (PingServer)
+    alive: np.ndarray = field(default=None)  # bool [n_shards, n_replicas]
 
     def __post_init__(self):
         if self.alive is None:
-            self.alive = np.ones(self.n_shards, dtype=bool)
+            self.alive = np.ones((self.n_shards, self.n_replicas),
+                                 dtype=bool)
 
     def shard_of_docid(self, docid) -> np.ndarray:
         return posdb.shard_of_docid(docid, self.n_shards)
@@ -82,9 +84,18 @@ class HostMap:
             np.asarray([ghash.hash64(site)], np.uint64))[0]
             % np.uint64(self.n_shards))
 
-    def mark_dead(self, shard: int) -> None:
+    def mark_dead(self, shard: int, replica: int = 0) -> None:
         """PingServer dead-host marking (``PingServer.h:61``)."""
-        self.alive[shard] = False
+        self.alive[shard, replica] = False
 
-    def mark_alive(self, shard: int) -> None:
-        self.alive[shard] = True
+    def mark_alive(self, shard: int, replica: int = 0) -> None:
+        self.alive[shard, replica] = True
+
+    def serving_replica(self, shard: int) -> int | None:
+        """First alive replica of a shard — the read-side twin pick
+        (``Multicast::pickBestHost`` skips dead twins,
+        ``Multicast.cpp:520``); None when the whole shard is down."""
+        for r in range(self.n_replicas):
+            if self.alive[shard, r]:
+                return r
+        return None

@@ -67,8 +67,14 @@ class ShardedCollection:
         self.name = name
         self.base_dir = Path(base_dir)
         self.hostmap = HostMap(n_shards, n_replicas)
-        self.shards = [
-            Collection(name, self.base_dir / f"shard_{s:03d}")
+        # grid[s][r]: replica r of shard s — the reference's twins
+        # within a shard group (Hostdb "num-mirrors"); replica 0 keeps
+        # the unsuffixed directory so single-replica layouts carry over
+        self.grid = [
+            [Collection(name, self.base_dir /
+                        (f"shard_{s:03d}" if r == 0
+                         else f"shard_{s:03d}_r{r}"))
+             for r in range(n_replicas)]
             for s in range(n_shards)
         ]
 
@@ -77,15 +83,33 @@ class ShardedCollection:
         return self.hostmap.n_shards
 
     @property
+    def shards(self) -> list[Collection]:
+        """Serving replica per shard (Multicast pick-best-twin); falls
+        back to replica 0 when the whole shard is dead — reads then
+        degrade at the query layer, which checks liveness itself."""
+        return [self.grid[s][self.hostmap.serving_replica(s) or 0]
+                for s in range(self.n_shards)]
+
+    def replicas_of(self, shard: int) -> list[Collection]:
+        """All twins of a shard — the write fan-out set (Msg1 adds go to
+        every twin, ``Msg1.cpp:20``)."""
+        return self.grid[shard]
+
+    @property
     def num_docs(self) -> int:
-        return sum(c.num_docs for c in self.shards)
+        return sum(row[0].num_docs for row in self.grid)
 
     # --- build plane: route records by shard (Msg4 / Msg1 semantics) ---
 
     def _linkdb_of(self, site: str):
-        """The shard owning a site's linkdb records (linkee-site routed,
-        like the reference's RDB_LINKDB shard map)."""
+        """The serving linkdb for a site's records (linkee-site routed,
+        like the reference's RDB_LINKDB shard map) — read side."""
         return self.shards[self.hostmap.shard_of_site(site)].linkdb
+
+    def _linkdbs_all(self, site: str):
+        """All twins' linkdbs for a site — write fan-out."""
+        return [c.linkdb for c in
+                self.replicas_of(self.hostmap.shard_of_site(site))]
 
     def site_num_inlinks(self, site: str) -> int:
         return self._linkdb_of(site).site_num_inlinks(site)
@@ -106,20 +130,26 @@ class ShardedCollection:
                                      inlinks=inlinks)
         home = int(self.hostmap.shard_of_docid(ml.docid))
         key_shards = self.hostmap.shard_of_keys(ml.posdb_keys)
+        # every record goes to ALL twins of its owning shard (the Msg1
+        # twin-add fan-out, Msg1.cpp:20)
         for s in np.unique(key_shards):
-            self.shards[int(s)].posdb.add(ml.posdb_keys[key_shards == s])
-        coll = self.shards[home]
-        coll.titledb.add(ml.titledb_key.reshape(1), [ml.title_rec])
-        coll.clusterdb.add(ml.clusterdb_key.reshape(1))
-        coll.titlerec_cache.pop(ml.docid, None)
-        coll.doc_added()
+            for coll in self.replicas_of(int(s)):
+                coll.posdb.add(ml.posdb_keys[key_shards == s])
+        for coll in self.replicas_of(home):
+            coll.titledb.add(ml.titledb_key.reshape(1), [ml.title_rec])
+            coll.clusterdb.add(ml.clusterdb_key.reshape(1))
+            coll.titlerec_cache.pop(ml.docid, None)
+            coll.doc_added()
+            if ml.words:
+                coll.speller.add_doc_words(ml.words)
         # outlink edges → linkee-site shards; refresh affected linkees
         # (shared propagate step, including the old version's linkees)
         edges = docproc.outlink_edges(ml, u.full)
         for linkee, anchor in edges:
-            self._linkdb_of(linkee.site).add_link(
-                linkee.site, u.site, u.full, linkee_url=linkee.full,
-                anchor_text=anchor, linker_siterank=siterank)
+            for ldb in self._linkdbs_all(linkee.site):
+                ldb.add_link(
+                    linkee.site, u.site, u.full, linkee_url=linkee.full,
+                    anchor_text=anchor, linker_siterank=siterank)
         if propagate:
             affected = [e[0] for e in edges]
             if old:
@@ -148,24 +178,28 @@ class ShardedCollection:
         ml = docproc.get_document(self.shards[home], url=url)
         if ml is None:
             return None
-        # regenerate tombstones and scatter them the same way
+        # regenerate tombstones and scatter them the same way (all twins)
         dead = docproc.tombstone_meta_list(ml)
         key_shards = self.hostmap.shard_of_keys(dead.posdb_keys)
         for s in np.unique(key_shards):
-            self.shards[int(s)].posdb.add(dead.posdb_keys[key_shards == s])
-        coll = self.shards[home]
-        coll.titledb.add(dead.titledb_key.reshape(1), [b""])
-        coll.clusterdb.add(dead.clusterdb_key.reshape(1))
-        coll.titlerec_cache.pop(dead.docid, None)
+            for coll in self.replicas_of(int(s)):
+                coll.posdb.add(dead.posdb_keys[key_shards == s])
+        for coll in self.replicas_of(home):
+            coll.titledb.add(dead.titledb_key.reshape(1), [b""])
+            coll.clusterdb.add(dead.clusterdb_key.reshape(1))
+            coll.titlerec_cache.pop(dead.docid, None)
+            if dead.words:
+                coll.speller.remove_doc_words(dead.words)
+            coll.doc_removed()
         u = normalize(url)
         edges = docproc.outlink_edges(dead, u.full)
         for linkee, _anchor in edges:
             if linkee.site == u.site:
                 continue
-            self._linkdb_of(linkee.site).rdb.delete(
-                link_key(linkee.site, linkee.full, u.site,
-                         u.full).reshape(1))
-        coll.doc_removed()
+            for ldb in self._linkdbs_all(linkee.site):
+                ldb.rdb.delete(
+                    link_key(linkee.site, linkee.full, u.site,
+                             u.full).reshape(1))
         if propagate:
             self._refresh_linkees([e[0] for e in edges], u.site)
         return dead
@@ -176,8 +210,9 @@ class ShardedCollection:
         return docproc.get_document(self.shards[home], docid=docid)
 
     def save(self) -> None:
-        for c in self.shards:
-            c.save()
+        for row in self.grid:
+            for c in row:
+                c.save()
 
 
 # ---------------------------------------------------------------------------
@@ -276,13 +311,16 @@ def _sharded_score(mesh, doc_idx, payload, slot, valid, freq_weight,
       scored, siterank, doclang, qlang, n_docs)
 
 
-def _global_freq_weights(preps: list[PreparedQuery],
+def _global_freq_weights(preps: list[PreparedQuery | None],
                          plan: QueryPlan, num_docs: int) -> np.ndarray:
     """Cluster-wide term-frequency weights: per-shard unique-doc counts
     summed — including shards with no candidates, whose postings still
     count toward document frequency (the reference ships global
-    termFreqWeights in the Msg39 request, computed at the Msg3a layer)."""
-    counts = sum(p.unique_counts for p in preps)
+    termFreqWeights in the Msg39 request, computed at the Msg3a layer).
+    Fully-dead shards (None) can't be counted — degraded stats."""
+    counts = sum(p.unique_counts for p in preps if p is not None)
+    if isinstance(counts, int):  # every shard down
+        counts = np.zeros(len(plan.groups), np.int64)
     return weights.term_freq_weight(counts, max(num_docs, 1))
 
 

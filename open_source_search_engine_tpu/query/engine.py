@@ -50,6 +50,10 @@ class SearchResults:
     results: list[Result] = field(default_factory=list)
     clustered: int = 0  # results hidden by site clustering (Msg51)
     suggestion: str | None = None  # "did you mean" (Speller)
+    #: True when a whole shard (every twin) was down and its documents
+    #: are missing from this answer — the reference surfaces this on
+    #: PageHosts; silent partial results are a correctness trap
+    degraded: bool = False
 
 
 def build_results(get_doc, docids, scores, plan: QueryPlan, *,
