@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import time
 from collections import Counter
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import numpy as np
 
@@ -62,6 +62,9 @@ class MetaList:
     langid: int
     site: str
     words: list[str] | None = None  # doc vocabulary (feeds the Speller)
+    #: linkees whose anchor set this add/remove touched — the next
+    #: propagation wave (consumed by :func:`refresh_linkees`)
+    refresh_targets: list = field(default_factory=list)
 
 
 def _density_ranks(hashgroups: np.ndarray, sentences: np.ndarray) -> np.ndarray:
@@ -286,14 +289,31 @@ def needs_link_refresh(fresh: list, stored: list) -> bool:
     return len(fresh) >= 2 * len(stored)
 
 
+#: bound on anchor-refresh cascades along link chains (the reference
+#: defers LinkInfo updates, so long chains settle over multiple crawl
+#: rounds rather than in one synchronous walk)
+MAX_REFRESH_DEPTH = 8
+
+
 def refresh_linkees(linkees, own_site: str, *, get_doc, linkdb_of,
-                    reindex) -> None:
+                    reindex, max_depth: int = MAX_REFRESH_DEPTH) -> None:
     """Shared propagate step (single-node and sharded flows): for each
     external linkee already indexed, compare its stored inlink snapshot
-    with a fresh harvest and reindex when stale."""
+    with a fresh harvest and reindex when stale.
+
+    Propagation is an iterative breadth-first worklist with a visited
+    set and depth cap — NOT recursion: ``reindex(linkee, rec)`` must
+    perform a non-propagating reindex and return its ``MetaList`` (or
+    None); the next wave is that list's ``refresh_targets``, enqueued
+    here. Long link chains therefore cannot blow the Python stack, and
+    a page is refreshed at most once per propagation."""
+    from collections import deque
+
     seen: set[str] = set()
-    for linkee in linkees:
-        if linkee.site == own_site or linkee.full in seen:
+    work = deque((lk, own_site, 0) for lk in linkees)
+    while work:
+        linkee, src_site, depth = work.popleft()
+        if linkee.site == src_site or linkee.full in seen:
             continue
         seen.add(linkee.full)
         rec = get_doc(linkee)
@@ -303,7 +323,10 @@ def refresh_linkees(linkees, own_site: str, *, get_doc, linkdb_of,
                                                        linkee.full)
         stored = [tuple(x) for x in rec.get("inlinks") or []]
         if needs_link_refresh(fresh, stored):
-            reindex(linkee, rec)
+            ml = reindex(linkee, rec)
+            if ml is not None and depth + 1 < max_depth:
+                work.extend((l2, linkee.site, depth + 1)
+                            for l2 in ml.refresh_targets)
 
 
 def index_document(coll: Collection, url: str, content: str, *,
@@ -337,21 +360,23 @@ def index_document(coll: Collection, url: str, content: str, *,
         coll.linkdb.add_link(linkee.site, u.site, u.full,
                              linkee_url=linkee.full, anchor_text=anchor,
                              linker_siterank=siterank)
+    ml.refresh_targets = [e[0] for e in edges]
+    if old is not None:
+        ml.refresh_targets += old.refresh_targets
     if propagate:
-        affected = [e[0] for e in edges]
-        if old is not None:
-            affected += [e[0] for e in outlink_edges(old, u.full)]
         refresh_linkees(
-            affected, u.site,
+            ml.refresh_targets, u.site,
             get_doc=lambda lk: get_document(coll, url=lk.full),
             linkdb_of=lambda _site: coll.linkdb,
-            reindex=lambda lk, rec: reindex_document(coll, lk.full))
+            reindex=lambda lk, rec: reindex_document(
+                coll, lk.full, propagate=False))
     log.debug("indexed %s docid=%d keys=%d inlinks=%d", url, ml.docid,
               len(ml.posdb_keys), len(inlinks))
     return ml
 
 
-def reindex_document(coll: Collection, url: str) -> MetaList | None:
+def reindex_document(coll: Collection, url: str, *,
+                     propagate: bool = True) -> MetaList | None:
     """Re-index a document from its stored content — fresh inlink
     harvest + recomputed link-derived siterank (the reference's reindex
     path, ``Repair.cpp``/``PageReindex`` semantics)."""
@@ -364,7 +389,7 @@ def reindex_document(coll: Collection, url: str) -> MetaList | None:
         coll, url, rec.get("content", rec["text"]),
         is_html=rec.get("is_html", True),
         siterank=site_rank(coll.linkdb.site_num_inlinks(u.site)),
-        langid=rec.get("langid"))
+        langid=rec.get("langid"), propagate=propagate)
 
 
 def tombstone_meta_list(rec: dict) -> MetaList:
@@ -419,13 +444,15 @@ def remove_document(coll: Collection, url: str, _count: bool = True,
         coll.speller.remove_doc_words(ml.words)
     if _count:
         coll.doc_removed()
+    ml.refresh_targets = [e[0] for e in edges]
     if propagate:
         # former linkees lose this page's anchor — refresh them
         refresh_linkees(
-            [e[0] for e in edges], u.site,
+            ml.refresh_targets, u.site,
             get_doc=lambda lk: get_document(coll, url=lk.full),
             linkdb_of=lambda _site: coll.linkdb,
-            reindex=lambda lk, _rec: reindex_document(coll, lk.full))
+            reindex=lambda lk, _rec: reindex_document(
+                coll, lk.full, propagate=False))
     return ml
 
 

@@ -77,6 +77,9 @@ class ShardedCollection:
              for r in range(n_replicas)]
             for s in range(n_shards)
         ]
+        #: monotonic corpus mutation counter (invalidates merged-view
+        #: caches even when a replace leaves num_docs unchanged)
+        self.mutations = 0
 
     @property
     def n_shards(self) -> int:
@@ -122,6 +125,7 @@ class ShardedCollection:
         termid shard, titledb+clusterdb to the docid's shard, linkdb
         edges to the linkee site's shard)."""
         from ..utils.url import normalize
+        self.mutations += 1
         old = self.remove_document(url, propagate=False)
         u = normalize(url)
         inlinks = self._linkdb_of(u.site).inlinks_for_url(u.site, u.full)
@@ -150,15 +154,17 @@ class ShardedCollection:
                 ldb.add_link(
                     linkee.site, u.site, u.full, linkee_url=linkee.full,
                     anchor_text=anchor, linker_siterank=siterank)
+        ml.refresh_targets = [e[0] for e in edges]
+        if old:
+            ml.refresh_targets += old.refresh_targets
         if propagate:
-            affected = [e[0] for e in edges]
-            if old:
-                affected += [e[0] for e in
-                             docproc.outlink_edges(old, u.full)]
-            self._refresh_linkees(affected, u.site)
+            self._refresh_linkees(ml.refresh_targets, u.site)
         return ml
 
     def _refresh_linkees(self, linkees, own_site: str) -> None:
+        """Breadth-first anchor propagation (iterative worklist in
+        :func:`docproc.refresh_linkees`; each reindex is non-propagating
+        and feeds its own affected linkees back into the queue)."""
         from ..spider.linkdb import site_rank
         docproc.refresh_linkees(
             linkees, own_site,
@@ -168,11 +174,12 @@ class ShardedCollection:
                 lk.full, rec.get("content", rec["text"]),
                 is_html=rec.get("is_html", True),
                 siterank=site_rank(self.site_num_inlinks(lk.site)),
-                langid=rec.get("langid")))
+                langid=rec.get("langid"), propagate=False))
 
     def remove_document(self, url: str, propagate: bool = True):
         from ..spider.linkdb import pack_key as link_key
         from ..utils.url import normalize
+        self.mutations += 1
         docid = _docid_of(url)
         home = int(self.hostmap.shard_of_docid(docid))
         ml = docproc.get_document(self.shards[home], url=url)
@@ -200,8 +207,9 @@ class ShardedCollection:
                 ldb.rdb.delete(
                     link_key(linkee.site, linkee.full, u.site,
                              u.full).reshape(1))
+        dead.refresh_targets = [e[0] for e in edges]
         if propagate:
-            self._refresh_linkees([e[0] for e in edges], u.site)
+            self._refresh_linkees(dead.refresh_targets, u.site)
         return dead
 
     def get_document(self, docid: int) -> dict | None:
@@ -333,17 +341,24 @@ def sharded_search(sc: ShardedCollection, q: str | QueryPlan, *,
     if mesh is None:
         mesh = make_mesh(sc.n_shards)
 
-    preps = [prepare_query(c, plan) for c in sc.shards]
+    # a shard with NO alive twin contributes nothing — not even term
+    # stats; the answer is flagged degraded (the reference surfaces dead
+    # hosts on PageHosts; silent partial results are a correctness trap)
+    serving = [sc.hostmap.serving_replica(s) for s in range(sc.n_shards)]
+    degraded = any(r is None for r in serving)
+    preps = [prepare_query(c, plan) if serving[i] is not None else None
+             for i, c in enumerate(sc.shards)]
     freqw = _global_freq_weights(preps, plan, sc.num_docs)
 
     # dead shards contribute an empty block: the query degrades instead
     # of failing, like Multicast skipping dead twins (Multicast.cpp:520);
     # with replicas configured the replica's collection serves instead
-    packs = [pack_pass(p) if sc.hostmap.alive[i] else None
-             for i, p in enumerate(preps)]
+    packs = [pack_pass(p) if p is not None else None for p in preps]
     live = [p for p in packs if p is not None]
     if not live:
-        return SearchResults(query=plan.raw, total_matches=0)
+        return SearchResults(query=plan.raw, total_matches=0,
+                             degraded=degraded,
+                             suggestion=suggest_sharded(sc, plan))
     T = max(p.doc_idx.shape[0] for p in live)
     L = max(p.doc_idx.shape[1] for p in live)
     D = max(len(p.siterank) for p in live)
@@ -408,5 +423,30 @@ def sharded_search(sc: ShardedCollection, q: str | QueryPlan, *,
         if (len(results) >= topk or clustered == 0 or out_k >= max_out):
             break
         out_k *= 4
-    return SearchResults(query=plan.raw, total_matches=int(total),
-                         results=results, clustered=clustered)
+    return SearchResults(
+        query=plan.raw, total_matches=int(total), results=results,
+        clustered=clustered, degraded=degraded,
+        suggestion=suggest_sharded(sc, plan) if total == 0 else None)
+
+
+def suggest_sharded(sc: ShardedCollection, plan: QueryPlan) -> str | None:
+    """Cluster-wide "did you mean": per-shard popularity dictionaries
+    merged so a word common on ONE shard is not misdiagnosed as a typo
+    (the reference's Speller dict is host-global; ours shards with the
+    docs, so the Msg3a layer merges counts). The merged view is cached
+    per topology+corpus version — zero-result queries must stay cheap."""
+    from ..query.speller import merged
+    words = [g.display for g in plan.scored_groups if " " not in g.display]
+    if not words:
+        return None
+    live = [sc.grid[s][r].speller
+            for s in range(sc.n_shards)
+            if (r := sc.hostmap.serving_replica(s)) is not None]
+    if not live:
+        return None
+    key = (sc.mutations, tuple(id(s) for s in live))
+    cached = getattr(sc, "_merged_speller", None)
+    if cached is None or cached[0] != key:
+        cached = (key, merged(live))
+        sc._merged_speller = cached
+    return cached[1].suggest_query(words)

@@ -102,3 +102,17 @@ class Speller:
             else:
                 out.append(w)
         return " ".join(out) if changed else None
+
+
+def merged(spellers: list[Speller]) -> Speller:
+    """Read-only merged view over per-shard dictionaries: popularity
+    counts summed, so cluster-wide suggestions see the whole corpus
+    (used by the sharded zero-result fallback). Not saveable."""
+    m = Speller.__new__(Speller)
+    m.path = None
+    m.counts = defaultdict(int)
+    for s in spellers:
+        for w, c in s.counts.items():
+            m.counts[w] += c
+    m._len_index = None
+    return m

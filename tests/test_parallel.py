@@ -143,3 +143,49 @@ class TestShardedSearch:
         assert sharded_search(sc, "unobtainium", mesh=mesh).results
         assert sc.remove_document(url)
         assert not sharded_search(sc, "unobtainium", mesh=mesh).results
+
+    def test_suggestion_merges_shards(self, sc, mesh):
+        """Zero-result sharded queries get a cluster-wide 'did you
+        mean' from the merged per-shard dictionaries."""
+        res = sharded_search(sc, "discusses everywere", mesh=mesh)
+        assert res.total_matches == 0
+        assert res.suggestion == "discusses everywhere"
+
+
+class TestReplicas:
+    """Twin serving + failover on a replicated topology (num-mirrors)."""
+
+    @pytest.fixture()
+    def rsc(self, tmp_path, mesh):
+        s = ShardedCollection("rtest", tmp_path / "rtest",
+                              n_shards=4, n_replicas=2)
+        for url, html in DOCS.items():
+            s.index_document(url, html)
+        return s
+
+    def test_replicated_search_works(self, rsc, mesh):
+        res = sharded_search(rsc, "gem", mesh=mesh)
+        assert len(res.results) == 1 and not res.degraded
+
+    def test_twin_failover_serves_identically(self, rsc, mesh):
+        baseline = sharded_search(rsc, "topic1", mesh=mesh, topk=20,
+                                  site_cluster=False)
+        for s in range(rsc.n_shards):
+            rsc.hostmap.mark_dead(s, 0)  # replica 1 takes over everywhere
+        res = sharded_search(rsc, "topic1", mesh=mesh, topk=20,
+                             site_cluster=False)
+        assert not res.degraded
+        assert [(r.docid, r.score) for r in res.results] == \
+               [(r.docid, r.score) for r in baseline.results]
+
+    def test_whole_shard_dead_degrades(self, rsc, mesh):
+        baseline = sharded_search(rsc, "topic1", mesh=mesh, topk=20)
+        rsc.hostmap.mark_dead(1, 0)
+        rsc.hostmap.mark_dead(1, 1)
+        res = sharded_search(rsc, "topic1", mesh=mesh, topk=20)
+        assert res.degraded
+        assert res.total_matches <= baseline.total_matches
+        rsc.hostmap.mark_alive(1, 0)
+        res2 = sharded_search(rsc, "topic1", mesh=mesh, topk=20)
+        assert not res2.degraded
+        assert res2.total_matches == baseline.total_matches
