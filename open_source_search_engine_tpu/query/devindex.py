@@ -1,55 +1,74 @@
-"""Device-resident index — the shard's termlists live in HBM.
+"""Device-resident index — two-phase pruned search, the shard's postings
+and per-(term, doc) impact bounds live in HBM.
 
-This is the SURVEY §7 architecture stated plainly: "posting lists as
-padded int32/int64 HBM arrays … the device query plane". The host-packed
-path (packer.py) ships each query's termlists to the device — correct,
-but on tunneled TPU backends the per-query transfer dwarfs the compute.
-Here the whole shard's posting store uploads ONCE; a query ships only
-its term-run offsets (a few dozen int32s) and gets the packed top-k
-back: one RPC up, one down. Queries also batch (vmap over the query
-axis) — the throughput mode the reference's per-query callback
-architecture fundamentally cannot express.
+This is the SURVEY §7 architecture plus the reference's own pruning idea
+compiled into one XLA program. The reference never scores every docid:
+``intersectLists10_r`` computes a cheap ``maxPossibleScore`` per docid and
+skips docids that cannot beat the TopTree floor (``Posdb.cpp:6052``; the
+"pre-advance" pruning around ``docIdLoop:`` 6137). On a TPU the same idea
+becomes two dense phases:
 
-Round-2 redesign (scale correctness):
+* **Phase 1 — candidates.** Per term group, accumulate a per-doc score
+  *upper bound* over the whole doc space ``[T, D]``: precomputed
+  per-(term, doc) **impact columns** (the hashgroup-deduped sum of
+  position scores — an admissible bound on the group's single-term
+  score, and exact for docs with ≤ MAX_TOP distinct hashgroups) are
+  added — via plain vectorized adds for high-df terms kept as dense
+  ``[V, D]`` rows, and one fused gather+scatter for sparse/delta terms.
+  Base and delta accumulate separately so the dead-doc vector masks
+  only base contributions (re-adds serve from the delta; tombstones
+  that no longer match the base still kill the doc). Boolean
+  intersection (every required group present, no negative present —
+  ``Msg39``'s early-outs) plus the min-over-groups/pairs bound yields
+  an admissible per-doc upper bound; ``approx_max_k`` picks the top-κ
+  candidates. The exact match count and the exact max bound among
+  *non*-selected docs come out of the same pass, so pruning is
+  verifiable.
+* **Phase 2 — exact.** For the κ candidates only, gather the real
+  postings (run starts come from precomputed ``runstart|count`` columns
+  — no per-query binary search, no big scatter) into the dense
+  ``[T, P, κ]`` position cube and score with the exact docIdLoop
+  semantics (scorer.min_scores — identical math to the host-packed
+  path, so parity holds by construction).
+* **Escalation.** If the max bound among non-candidates exceeds the
+  k-th exact score (beyond a 1e-4 tie tolerance), rerun with κ×4
+  (rare: bounds are tight). This makes the pruning *lossless* — the
+  TPU analog of TopTree's floor check, and of the reference's recall
+  re-loop (``Msg40.cpp:2117``).
 
-* **Docid-tile streaming** — the doc axis is processed in fixed tiles of
-  ``TILE_DOCS`` docs via ``lax.scan``, merging top-k across tiles in the
-  scan carry. This is the reference's docid-range multipass
-  (``Msg39.cpp:277-305`` "docid range splitting") compiled into one XLA
-  program: per-query HBM is bounded by the tile cube ``[TD, T, P]``
-  regardless of corpus size, and posting runs of ANY length score fully
-  (the former 32k-per-run truncation is gone). Only tiles containing
-  driver-term postings are scanned (the driver = smallest required
-  group, exactly ``setQueryTermInfo``'s "pick smallest list" rule), so
-  work scales with the rarest term, not the corpus.
-* **Base + delta repack** — the device arrays split into an immutable
-  *base* (built from the Rdb's on-disk runs) and a small *delta* (built
-  from the memtable). A document add/delete rebuilds only the delta —
-  O(memtable), not O(corpus); the base rebuilds only when the run set
-  changes (dump/merge), which the Rdb amortizes over its memtable
-  budget. This is SURVEY §7 hard part (d): delta memtable → periodic
-  repack. Deletions ride a device-side ``dead`` doc mask (memtable
-  tombstones cover whole documents — the delete path regenerates the
-  full old meta list, ``XmlDoc::getMetaList`` del path — so tombstoned
-  docids simply mask their base postings; re-adds live in the delta).
+Why this shape: on v5e, scalar gather runs ~60 Melem/s and scatter ~10
+Melem/s, while dense row ops and 128-lane block gathers run 10-100×
+faster. So the per-query work that scales with the corpus (phase 1) uses
+only dense ops + one bounded scatter, and the slow scalar gathers are
+confined to phase 2's κ·T·P lanes. The former design (docid-tile scan
+with per-tile gather+rank+scatter) paid the scatter price on every
+posting of every tile and recompiled per posting-length bucket; this one
+has no per-query shape that depends on posting-list length.
 
-Layout (built from the Rdb, reference Msg2/RdbList read path collapsed):
+Admissibility of the bounds (what makes pruning exact):
 
-* postings sorted by (termid, doc-index, wordpos) as resident columns:
-  ``docidx`` int32 [N] (posting → doc-table index) and ``payload``
-  uint32 [N] (wordpos|hg|density|spam bits, packer layout) — one pair
-  for the base, one for the delta;
-* host-side term directories termid → [start, end) run (``RdbMap``'s
-  role, one binary search per query sublist) with precomputed per-term
-  document frequencies (the Msg36/Msg37 termfreq role — exact counts,
-  maintained under deletes via tombstone-pair subtraction);
-* a doc table: docids uint64 (host) + siterank/langid/dead int32/bool
-  [D_cap] (device) — Clusterdb's query-time role.
+* group single-term score = Σ of the top-MAX_TOP hashgroup-deduped
+  position scores ≤ the stored impact (Σ over ALL mapped-hashgroup
+  maxima + every inlink-text occurrence; synonym sublists score ×0.90²
+  at query time — bounded by 1);
+* pair score ≤ BASE·maxposw_i·maxposw_j·fw_i·fw_j (min distance term
+  ≥ 1 after the qdist adjustment) and BASE·maxposw² ≤ impact, so
+  √(impact_i·fw_i²·impact_j·fw_j²) bounds every pair term;
+* siterank/language multipliers are exact (dense per-doc columns);
+* the final ×(1+1e-5) guards float reassociation (the escalation check
+  allows 1e-4 so exact ties don't escalate forever).
 
-Per tile the kernel gathers each sublist's run segment, computes
-per-(sublist, doc) occurrence ranks (the mini-merge), scatters into the
-[TD, T, P] cube and reuses scorer.score_cube — identical semantics to
-the host-packed path.
+Incremental updates (SURVEY §7 hard part (d)): the base columns build
+once per Rdb run-set move (dump/merge); a memtable change rewrites only
+the delta tail of the preallocated device columns via donated
+dynamic-update-slice — O(memtable) transfer, no O(corpus) copies, no
+double residency. Document frequencies stay exact under deletes via the
+tombstone-pair subtraction (the Msg36/37 termfreq role).
+
+Capacity: run starts pack into 26 bits (count in the low 5 of an
+int32), capping a shard at 2^26 ≈ 67M stored postings (~500k web
+pages) — beyond that the corpus must shard (``parallel/``), same as
+the reference's per-host index splits.
 """
 
 from __future__ import annotations
@@ -69,18 +88,82 @@ from . import weights
 from .compiler import SUB_SYNONYM, QueryPlan, compile_query
 from .packer import (MAX_POSITIONS, T_FLOOR, _bucket, _pad1, group_flags,
                      pack_payload)
+from .scorer import final_multipliers, min_scores
 
 log = get_logger("devindex")
 
 #: shape-bucket floors (distinct shape tuples = one XLA compile each)
-R_FLOOR = 8       # sublist rows
-L_FLOOR = 256     # postings per row per tile
-NT_FLOOR = 2      # active tiles
+RD_FLOOR = 4      # dense rows
+RS_FLOOR = 4      # sparse rows
+LSP_FLOOR = 512   # sparse gather lanes
+B_FLOOR = 4
+KAPPA_FLOOR = 256  # phase-2 candidate count
 DOC_UPD_FLOOR = 64
 
-#: docs per tile — the docid-range slice width (Msg39.cpp:277 multipass).
-#: Power of two so the doc-capacity bucket is always tile-aligned.
-TILE_DOCS = 2048
+#: doc-capacity quantum (D_cap bucket unit)
+DOC_QUANTUM = 2048
+
+#: HBM budget for dense [V, D_cap] impact+runstart rows (8 bytes/doc/term)
+DENSE_BUDGET_BYTES = 128 << 20
+
+#: posting/doc column padding quantum
+COL_QUANTUM = 1 << 15
+
+_RS_SHIFT = 5          # runstart<<5 | count  (count ≤ MAX_POSITIONS=16)
+_CNT_MASK = 31
+_MAX_POSTINGS = 1 << (31 - _RS_SHIFT)  # int32 rs|cnt pack limit (2^26)
+
+#: escalation tie tolerance (× the 1e-5 admissibility inflation)
+_TIE_TOL = 1.0001
+
+
+def _posscore_np(f: dict[str, np.ndarray]) -> np.ndarray:
+    """Per-posting single-term score (BASE · posw², the initWeights
+    tables — Posdb.cpp:1105-1252), vectorized numpy for build time."""
+    hg = f["hashgroup"]
+    hgw = weights.HASH_GROUP_WEIGHTS[hg]
+    denw = weights.DENSITY_WEIGHTS[f["densityrank"]]
+    spamw = np.where(hg == posdb.HASHGROUP_INLINKTEXT,
+                     weights.LINKER_WEIGHTS[f["wordspamrank"]],
+                     weights.WORD_SPAM_WEIGHTS[f["wordspamrank"]])
+    posw = hgw * denw * spamw
+    return weights.BASE_SCORE * posw * posw
+
+
+def _impacts_np(f: dict[str, np.ndarray], termids: np.ndarray,
+                docidx: np.ndarray, runstart: np.ndarray) -> np.ndarray:
+    """Admissible per-(term, doc) single-score bound, tight for the
+    common case: Σ over mapped hashgroups of the max position score,
+    plus every inlink-text occurrence individually — exactly the
+    candidate set getSingleTermScore tops-and-sums (Posdb.cpp:3087),
+    summed without the top-MAX_TOP cut (≥ the exact score, equal when a
+    doc has ≤ MAX_TOP contributing groups)."""
+    n = len(termids)
+    if n == 0:
+        return np.empty(0, np.float32)
+    ps = _posscore_np(f)
+    mhg = weights.MAPPED_HASHGROUP[f["hashgroup"]].astype(np.int8)
+    is_inlink = f["hashgroup"] == posdb.HASHGROUP_INLINKTEXT
+    # order within each (term, doc) run by mapped hashgroup: runs are
+    # tiny (≤ P) so a stable argsort of the group key within runs via
+    # one global lexsort is fine
+    o = np.lexsort((mhg, docidx, termids))
+    ps_o, mh_o, il_o = ps[o], mhg[o], is_inlink[o]
+    t_o, d_o = termids[o], docidx[o]
+    gch = np.ones(n, bool)
+    gch[1:] = ((t_o[1:] != t_o[:-1]) | (d_o[1:] != d_o[:-1])
+               | (mh_o[1:] != mh_o[:-1]))
+    gstart = np.nonzero(gch)[0]
+    gmax = np.maximum.reduceat(ps_o, gstart)
+    gsum = np.add.reduceat(ps_o, gstart)
+    gval = np.where(il_o[gstart], gsum, gmax)
+    pch = np.ones(len(gstart), bool)
+    pch[1:] = ((t_o[gstart][1:] != t_o[gstart][:-1])
+               | (d_o[gstart][1:] != d_o[gstart][:-1]))
+    imp = np.add.reduceat(gval, np.nonzero(pch)[0])
+    assert len(imp) == len(runstart)
+    # tiny floor keeps zero-weight hashgroups present-but-worthless
+    return np.maximum(imp, 1e-30).astype(np.float32)
 
 
 def _occ_ranks(termids: np.ndarray, docs: np.ndarray) -> np.ndarray:
@@ -110,43 +193,63 @@ def _term_dfs(termids: np.ndarray, newpair: np.ndarray):
     return termids[starts].copy(), np.r_[starts, n].astype(np.int64), df
 
 
+def _pad_col(a: np.ndarray, size: int) -> np.ndarray:
+    out = np.zeros(size, a.dtype)
+    out[: len(a)] = a
+    return out
+
+
+@partial(jax.jit, donate_argnums=0)
+def _write_tail(buf, tail, offset):
+    """Donated in-place rewrite of the delta tail of a device column."""
+    return jax.lax.dynamic_update_slice(buf, tail, (offset,))
+
+
 class _DeltaOverflow(Exception):
-    def __init__(self, needed_docs: int):
+    def __init__(self, needed_docs: int = 0, needed_cols: int = 0):
         self.needed_docs = needed_docs
+        self.needed_cols = needed_cols
 
 
 @dataclass
 class ResidentPlan:
-    """Host-computed gather plan for one query (all tiny arrays)."""
+    """Host-computed execution plan for one query (all tiny arrays)."""
 
-    tiles: np.ndarray        # int32 [NT] active tile ids (driver's tiles)
-    seg_start: np.ndarray    # int32 [R, NT] per-row per-tile run starts
-    seg_len: np.ndarray      # int32 [R, NT] segment lengths (0 = empty)
-    group: np.ndarray        # int32 [R] row → term group
-    base: np.ndarray         # int32 [R] slot base within the group's P
-    quota: np.ndarray        # int32 [R] max positions per (sublist, doc)
-    is_base: np.ndarray      # bool [R] row reads base (vs delta) columns
-    syn: np.ndarray          # uint32 [R] synonym flag (SYNONYM_WEIGHT)
+    # dense rows: term's doc run lives as a dense [D_cap] impact row
+    d_slot: np.ndarray       # int32 [Rd] dense matrix row (-1 = pad)
+    d_group: np.ndarray      # int32 [Rd]
+    d_base: np.ndarray       # int32 [Rd] slot base within the group's P
+    d_quota: np.ndarray      # int32 [Rd]
+    d_syn: np.ndarray        # uint32 [Rd]
+    # sparse rows: contiguous run of the doc/impact/runstart columns
+    s_start: np.ndarray      # int32 [Rs] absolute offset into doc cols
+    s_len: np.ndarray        # int32 [Rs]
+    s_group: np.ndarray      # int32 [Rs]
+    s_base: np.ndarray       # int32 [Rs]
+    s_quota: np.ndarray      # int32 [Rs]
+    s_syn: np.ndarray        # uint32 [Rs]
+    s_isbase: np.ndarray     # bool [Rs] (base postings dead-mask)
+    # per-group query state
     freq_weight: np.ndarray  # float32 [T]
     required: np.ndarray     # bool [T]
     negative: np.ndarray     # bool [T]
     scored: np.ndarray       # bool [T]
     qlang: int
-    matchable: bool  # False = no required group, or one has no postings
+    matchable: bool
+    driver_df: int = 0       # min required-group df (escalation bound)
 
 
 class DeviceIndex:
-    """One collection's postings, resident on the default device."""
+    """One collection's postings + impact bounds, resident in HBM."""
 
-    def __init__(self, coll: Collection, max_positions: int = MAX_POSITIONS,
-                 tile_docs: int = TILE_DOCS):
+    def __init__(self, coll: Collection, max_positions: int = MAX_POSITIONS):
         self.coll = coll
         self.P = max_positions
-        self.TD = tile_docs
         self._built_version = -1
         self._base_fp = None
         self.full_rebuilds = 0    # O(corpus) base rebuilds (run-set moved)
         self.delta_rebuilds = 0   # O(memtable) delta-only refreshes
+        self.escalations = 0      # phase-2 κ escalations (pruning misses)
         self.refresh()
 
     # --- build / refresh -------------------------------------------------
@@ -161,116 +264,172 @@ class DeviceIndex:
         fp = tuple((r.path.name, len(r)) for r in rdb.runs)
         if fp != self._base_fp:
             self._build_base(fp)
-        try:
-            self._build_delta()
-        except _DeltaOverflow as e:
-            # delta introduced more new docs than the doc-capacity
-            # headroom: rebuild base with room and retry (rare; the next
-            # Rdb dump folds the delta into runs anyway)
-            self._build_base(fp, min_docs=e.needed_docs)
+        # the delta can outgrow the doc-capacity headroom AND the
+        # preallocated column tails independently — regrow and retry
+        min_docs = min_delta = 0
+        for _ in range(3):
+            try:
+                self._build_delta()
+                break
+            except _DeltaOverflow as e:
+                min_docs = max(min_docs, e.needed_docs)
+                min_delta = max(min_delta, e.needed_cols)
+                self._build_base(fp, min_docs=min_docs,
+                                 min_delta=min_delta)
+        else:
             self._build_delta()
         self._built_version = rdb.version
         return True
 
-    def _build_base(self, fp, min_docs: int = 0) -> None:
-        """Base arrays from the Rdb's immutable runs (merged, tombstones
-        annihilated — the Msg5 read collapsed to one columnar merge)."""
+    def _build_base(self, fp, min_docs: int = 0, min_delta: int = 0
+                    ) -> None:
+        """Base columns from the Rdb's immutable runs (merged, tombstones
+        annihilated — the Msg5 read collapsed to one columnar merge),
+        plus preallocated delta tails."""
         runs = self.coll.posdb.runs
         batch = merge_batches([r.batch() for r in runs]) if runs else None
+        P = self.P
         if batch is not None and len(batch):
             f = posdb.unpack(batch.keys)
             termids, docids = f["termid"], f["docid"]
             occ = _occ_ranks(termids, docids)
-            self.dir_termids, self.dir_start, self.base_df = _term_dfs(
-                termids, occ == 0)
-            # store-cap: scoring consumes ≤ P positions per (group, doc)
-            # (packer slot cap / mini-merge buffer cap), so postings past
-            # occurrence P are dead weight in HBM — drop at build
-            keep = occ < self.P
-            termids, docids = termids[keep], docids[keep]
-            payload = pack_payload({k: v[keep] for k, v in f.items()})
-            siterank = f["siterank"][keep].astype(np.int32)
-            langid = f["langid"][keep].astype(np.int32)
-            # re-point run bounds at the capped columns
-            tchange = np.ones(len(termids), bool)
-            tchange[1:] = termids[1:] != termids[:-1]
-            starts = np.nonzero(tchange)[0]
-            self.dir_start = np.r_[starts, len(termids)].astype(np.int64)
+            self.dir_termids, _, self.base_df = _term_dfs(termids, occ == 0)
+            # store-cap: scoring consumes ≤ P positions per (term, doc),
+            # so postings past occurrence P are dead weight in HBM
+            keep = occ < P
+            f = {k: v[keep] for k, v in f.items()}
+            termids, docids = f["termid"], f["docid"]
+            if len(termids) >= _MAX_POSTINGS:
+                raise ValueError(
+                    f"shard exceeds {_MAX_POSTINGS} stored postings "
+                    "(runstart pack limit) — split the collection "
+                    "across more shards")
+            payload = pack_payload(f)
             self.base_docids = np.unique(docids)
             docidx = np.searchsorted(self.base_docids, docids).astype(
                 np.int32)
             n = len(docidx)
+            # --- doc-level runs: one entry per (term, doc) pair ---
+            newpair = np.ones(n, bool)
+            newpair[1:] = (termids[1:] != termids[:-1]) | \
+                (docidx[1:] != docidx[:-1])
+            runstart = np.nonzero(newpair)[0].astype(np.int64)
+            doc_col = docidx[newpair]
+            count = np.diff(np.r_[runstart, n])
+            imp_col = _impacts_np(f, termids, docidx, runstart)
+            rsp_col = ((runstart << _RS_SHIFT)
+                       | np.minimum(count, P)).astype(np.int32)
+            tchange = np.ones(n, bool)
+            tchange[1:] = termids[1:] != termids[:-1]
+            tstarts = np.nonzero(tchange)[0]
+            self.dir_dstart = np.r_[
+                np.searchsorted(runstart, tstarts), len(runstart)
+            ].astype(np.int64)
+            siterank = f["siterank"].astype(np.int32)
+            langid = f["langid"].astype(np.int32)
         else:
             self.dir_termids = np.empty(0, np.uint64)
-            self.dir_start = np.zeros(1, np.int64)
             self.base_df = np.empty(0, np.int64)
+            self.dir_dstart = np.zeros(1, np.int64)
             self.base_docids = np.empty(0, np.uint64)
             docidx = np.empty(0, np.int32)
             payload = np.empty(0, np.uint32)
+            doc_col = np.empty(0, np.int32)
+            imp_col = np.empty(0, np.float32)
+            rsp_col = np.empty(0, np.int32)
             siterank = langid = np.empty(0, np.int32)
             n = 0
+
         Db = len(self.base_docids)
         headroom = max(1024, Db // 4)
-        self.D_cap = _bucket(max(Db + headroom, min_docs, 1), self.TD)
+        self.D_cap = _bucket(max(Db + headroom, min_docs, 1), DOC_QUANTUM)
+
+        # --- doc meta table (first posting per doc supplies siterank/
+        # langid — reference getSiteRank(miniMergedList[0]), 6989) ---
         sr = np.zeros(self.D_cap, np.int32)
         dl = np.zeros(self.D_cap, np.int32)
         if n:
-            # first posting per doc supplies siterank/langid
-            # (reference: getSiteRank(miniMergedList[0]), Posdb.cpp:6989)
             first = np.unique(docidx, return_index=True)[1]
             sr[docidx[first]] = siterank[first]
             dl[docidx[first]] = langid[first]
-        self.h_docidx = docidx  # host copy: per-query tile segmentation
-        pad = lambda a, fill_dtype: a if len(a) else np.zeros(1, fill_dtype)
-        self.d_docidx = jax.device_put(pad(docidx, np.int32))
-        self.d_payload = jax.device_put(pad(payload, np.uint32))
+
+        # --- dense rows: highest-df terms get a dense [D_cap] impact +
+        # runstart row (phase 1 adds them with zero gather/scatter) ---
+        dfs = np.diff(self.dir_dstart)
+        tau = max(1024, self.D_cap // 16)
+        slots_budget = max(DENSE_BUDGET_BYTES // (8 * self.D_cap), 1)
+        eligible = np.nonzero(dfs > tau)[0]
+        eligible = eligible[np.argsort(-dfs[eligible], kind="stable")]
+        dense_terms = eligible[:slots_budget]
+        V = _bucket(max(len(dense_terms), 1), 8)
+        dense_imp = np.zeros((V, self.D_cap), np.float32)
+        dense_rsp = np.zeros((V, self.D_cap), np.int32)
+        self.dense_slot_of: dict[int, int] = {}
+        for slot, ti in enumerate(dense_terms):
+            a, b = int(self.dir_dstart[ti]), int(self.dir_dstart[ti + 1])
+            dense_imp[slot, doc_col[a:b]] = imp_col[a:b]
+            dense_rsp[slot, doc_col[a:b]] = rsp_col[a:b]
+            self.dense_slot_of[int(self.dir_termids[ti])] = slot
+
+        # --- device columns: base + preallocated delta tail ---
+        self.h_doc_col = doc_col
+        self.Nb = _bucket(max(n, 1), COL_QUANTUM)
+        self.Mb = _bucket(max(len(doc_col), 1), COL_QUANTUM)
+        # delta tail capacity scales with the base (grown on overflow)
+        self.N2 = max(_bucket(max(self.Nb // 4, min_delta, 1),
+                              COL_QUANTUM), COL_QUANTUM)
+        self.M2 = self.N2
+        self.d_payload = jax.device_put(
+            _pad_col(payload, self.Nb + self.N2))
+        self.d_doc = jax.device_put(_pad_col(doc_col, self.Mb + self.M2))
+        self.d_imp = jax.device_put(_pad_col(imp_col, self.Mb + self.M2))
+        self.d_rsp = jax.device_put(_pad_col(rsp_col, self.Mb + self.M2))
+        self.d_dense_imp = jax.device_put(dense_imp)
+        self.d_dense_rsp = jax.device_put(dense_rsp.reshape(-1))
         self.d_siterank = jax.device_put(sr)
         self.d_doclang = jax.device_put(dl)
         self.d_dead = jax.device_put(np.zeros(self.D_cap, bool))
         self._base_fp = fp
         self.full_rebuilds += 1
         log.info("device base built: %d postings, %d docs, %d terms "
-                 "(cap %d)", n, Db, len(self.dir_termids), self.D_cap)
+                 "(%d dense rows, cap %d)", n, Db, len(self.dir_termids),
+                 len(dense_terms), self.D_cap)
 
     def _build_delta(self) -> None:
-        """Delta arrays from the memtable — O(memtable) per refresh.
+        """Delta columns from the memtable — O(memtable) per refresh.
 
-        Tombstones (delbit 0) mark their docids dead in the base (whole-
-        doc granularity, the delete path's regenerated meta list) and
-        subtract from per-term dfs; positives become delta postings,
-        with brand-new docids appended to the doc table."""
+        Tombstones (delbit 0) and re-adds mark their base doc dead
+        (phase 1 masks base-side bounds, phase 2 masks base run counts)
+        and subtract from per-term dfs; positives become delta postings
+        + delta doc columns written into the preallocated tails."""
         Db = len(self.base_docids)
         mem = self.coll.posdb.mem.batch()
         self.tomb_df = np.zeros(len(self.dir_termids), np.int64)
+        dead = np.zeros(self.D_cap, bool)
         if not len(mem):
             self._set_empty_delta()
+            self.d_dead = jax.device_put(dead)
+            self.delta_rebuilds += 1
             return
         f = posdb.unpack(mem.keys)
         pos = f["delbit"].astype(bool)
 
         def base_idx_of(docids_arr):
-            """(base doc indexes, found mask) for a docid array."""
             di = np.searchsorted(self.base_docids, docids_arr)
             ok = di < Db
             ok[ok] = self.base_docids[di[ok]] == docids_arr[ok]
             return di, ok
 
-        # --- superseded base docs: explicitly tombstoned OR re-added in
-        # the delta. The second case matters because an identical-content
-        # re-index annihilates its tombstone/positive pairs inside the
-        # memtable (MemTable newest-wins dedup), leaving no tombstone —
-        # but the delta positives are authoritative (the indexer always
-        # regenerates a doc's FULL meta list), so the base copy must be
-        # dead-masked either way or the doc double-serves.
+        # superseded base docs: explicitly tombstoned OR re-added in the
+        # delta (an identical-content re-index annihilates its pairs in
+        # the memtable, so the delta positives are the only witness)
         t_di, t_ok = base_idx_of(f["docid"][~pos])
         p_di, p_ok = base_idx_of(f["docid"][pos])
         dead_idx = np.unique(np.concatenate([t_di[t_ok], p_di[p_ok]]))
+        dead[dead_idx] = True
 
-        # --- df subtraction: every distinct (term, superseded doc) pair
-        # named by a surviving tombstone OR a delta positive subtracts 1
-        # from the base df — but only when the pair actually exists in
-        # the base (tombstones that don't match the base, e.g. after a
-        # tokenizer change, must not underflow the count)
+        # distinct (term, superseded-doc) pairs → df subtraction (only
+        # where the pair actually exists in the base)
         pair_t = np.concatenate([f["termid"][~pos][t_ok],
                                  f["termid"][pos][p_ok]])
         pair_d = np.concatenate([t_di[t_ok], p_di[p_ok]]).astype(np.int64)
@@ -286,9 +445,9 @@ class DeviceIndex:
             ok[ok] = self.dir_termids[ti[ok]] == pair_t[ok]
             for term_i in np.unique(ti[ok]):
                 m = ok & (ti == term_i)
-                a, b = int(self.dir_start[term_i]), \
-                    int(self.dir_start[term_i + 1])
-                run = self.h_docidx[a:b]
+                a, b = int(self.dir_dstart[term_i]), \
+                    int(self.dir_dstart[term_i + 1])
+                run = self.h_doc_col[a:b]
                 ppos = np.searchsorted(run, pair_d[m])
                 inb = ppos < len(run)
                 inb[inb] = run[ppos[inb]] == pair_d[m][inb]
@@ -298,52 +457,73 @@ class DeviceIndex:
         if pos.any():
             fp_ = {k: v[pos] for k, v in f.items()}
             p_doc = fp_["docid"]
-            db_pos, in_base = p_di, p_ok
-            new_docids = np.unique(p_doc[~in_base])
+            new_docids = np.unique(p_doc[~p_ok])
             if Db + len(new_docids) > self.D_cap:
-                raise _DeltaOverflow(Db + len(new_docids))
+                raise _DeltaOverflow(needed_docs=Db + len(new_docids))
             docidx = np.where(
-                in_base, db_pos,
+                p_ok, p_di,
                 Db + np.searchsorted(new_docids, p_doc)).astype(np.int32)
             # delta sort key is (termid, DOC-INDEX, wordpos): new docs'
-            # indexes aren't docid-monotonic, and the tile kernel needs
-            # docidx-sorted runs for segmentation + rank scans
+            # indexes aren't docid-monotonic
             order = np.lexsort((fp_["wordpos"], docidx, fp_["termid"]))
             fp_ = {k: v[order] for k, v in fp_.items()}
             docidx = docidx[order]
             occ = _occ_ranks(fp_["termid"], docidx)
-            self.dir2_termids, self.dir2_start, self.delta_df = _term_dfs(
+            self.dir2_termids, _, self.delta_df = _term_dfs(
                 fp_["termid"], occ == 0)
             keep = occ < self.P
             fp_ = {k: v[keep] for k, v in fp_.items()}
             docidx = docidx[keep]
-            tchange = np.ones(len(docidx), bool)
-            tchange[1:] = fp_["termid"][1:] != fp_["termid"][:-1]
-            starts = np.nonzero(tchange)[0]
-            self.dir2_start = np.r_[starts, len(docidx)].astype(np.int64)
-            self.h2_docidx = docidx
             n2 = len(docidx)
-            cap2 = _bucket(max(n2, 1), 256)
-            d2d = np.zeros(cap2, np.int32)
-            d2d[:n2] = docidx
-            d2p = np.zeros(cap2, np.uint32)
-            d2p[:n2] = pack_payload(fp_)
-            self.d2_docidx = jax.device_put(d2d)
-            self.d2_payload = jax.device_put(d2p)
+            newpair = np.ones(n2, bool)
+            newpair[1:] = (fp_["termid"][1:] != fp_["termid"][:-1]) | \
+                (docidx[1:] != docidx[:-1])
+            runstart2 = np.nonzero(newpair)[0].astype(np.int64)
+            doc2_col = docidx[newpair]
+            if n2 > self.N2 or len(doc2_col) > self.M2:
+                raise _DeltaOverflow(needed_cols=max(n2, len(doc2_col)))
+            if self.Nb + n2 >= _MAX_POSTINGS:
+                raise ValueError(
+                    f"shard exceeds {_MAX_POSTINGS} stored postings — "
+                    "split the collection across more shards")
+            count2 = np.diff(np.r_[runstart2, n2])
+            imp2 = _impacts_np(fp_, fp_["termid"], docidx, runstart2)
+            # runstarts reference the combined column: delta postings
+            # live at [Nb, Nb + n2)
+            rsp2 = (((self.Nb + runstart2) << _RS_SHIFT)
+                    | np.minimum(count2, self.P)).astype(np.int32)
+            tchange = np.ones(n2, bool)
+            tchange[1:] = fp_["termid"][1:] != fp_["termid"][:-1]
+            tstarts = np.nonzero(tchange)[0]
+            self.dir2_dstart = np.r_[
+                np.searchsorted(runstart2, tstarts), len(runstart2)
+            ].astype(np.int64)
             self.all_docids = np.concatenate([self.base_docids, new_docids])
-            # doc-table updates: new docs + re-indexed docs get their
-            # siterank/langid from their first delta posting
+            payload2 = pack_payload(fp_)
+            # doc-table updates from first delta posting per doc
             first = np.unique(docidx, return_index=True)[1]
             upd_idx = docidx[first].astype(np.int32)
             upd_sr = fp_["siterank"][first].astype(np.int32)
             upd_dl = fp_["langid"][first].astype(np.int32)
+            # donated in-place rewrites of the delta tails
+            self.d_payload = _write_tail(
+                self.d_payload,
+                jax.device_put(_pad_col(payload2, self.N2)),
+                np.int32(self.Nb))
+            self.d_doc = _write_tail(
+                self.d_doc, jax.device_put(_pad_col(doc2_col, self.M2)),
+                np.int32(self.Mb))
+            self.d_imp = _write_tail(
+                self.d_imp, jax.device_put(_pad_col(imp2, self.M2)),
+                np.int32(self.Mb))
+            self.d_rsp = _write_tail(
+                self.d_rsp, jax.device_put(_pad_col(rsp2, self.M2)),
+                np.int32(self.Mb))
         else:
-            self._set_empty_delta(keep_tomb=True)
+            self._set_empty_delta()
             upd_idx = np.empty(0, np.int32)
             upd_sr = upd_dl = upd_idx
 
-        # apply small device-side updates (bucketed; padding repeats the
-        # first element — idempotent writes)
         def bpad(a, fill):
             out = np.full(_bucket(max(len(a), 1), DOC_UPD_FLOOR), fill,
                           a.dtype)
@@ -354,21 +534,16 @@ class DeviceIndex:
                 self.d_siterank, self.d_doclang,
                 bpad(upd_idx, upd_idx[0]), bpad(upd_sr, upd_sr[0]),
                 bpad(upd_dl, upd_dl[0]))
-        if len(dead_idx):
-            di32 = dead_idx.astype(np.int32)
-            self.d_dead = _apply_dead(self.d_dead, bpad(di32, di32[0]))
+        self.d_dead = jax.device_put(dead)
         self.delta_rebuilds += 1
 
-    def _set_empty_delta(self, keep_tomb: bool = False) -> None:
+    def _set_empty_delta(self) -> None:
         self.dir2_termids = np.empty(0, np.uint64)
-        self.dir2_start = np.zeros(1, np.int64)
+        self.dir2_dstart = np.zeros(1, np.int64)
         self.delta_df = np.empty(0, np.int64)
-        self.h2_docidx = np.empty(0, np.int32)
-        self.d2_docidx = jax.device_put(np.zeros(1, np.int32))
-        self.d2_payload = jax.device_put(np.zeros(1, np.uint32))
         self.all_docids = self.base_docids
-        if not keep_tomb:
-            self.delta_rebuilds += 1
+        # delta tails keep whatever stale content they hold — nothing
+        # references it (dir2 is empty), so no device write is needed
 
     @property
     def n_docs(self) -> int:
@@ -376,18 +551,22 @@ class DeviceIndex:
 
     # --- planning --------------------------------------------------------
 
-    def _runs_of(self, termid: int):
-        """[(is_base, start, end)] posting runs for a termid — base run
-        from the run directory, delta run from the memtable directory."""
+    def _druns_of(self, termid: int):
+        """[(is_base, dstart, dlen, dense_slot)] doc-column runs for a
+        termid (dense_slot ≥ 0 when the base run is a dense row)."""
         out = []
-        for is_base, dirs, starts in (
-                (True, self.dir_termids, self.dir_start),
-                (False, self.dir2_termids, self.dir2_start)):
-            i = int(np.searchsorted(dirs, np.uint64(termid)))
-            if i < len(dirs) and dirs[i] == termid:
-                a, b = int(starts[i]), int(starts[i + 1])
-                if b > a:
-                    out.append((is_base, a, b))
+        i = int(np.searchsorted(self.dir_termids, np.uint64(termid)))
+        if i < len(self.dir_termids) and self.dir_termids[i] == termid:
+            a, b = int(self.dir_dstart[i]), int(self.dir_dstart[i + 1])
+            if b > a:
+                out.append((True, a, b - a,
+                            self.dense_slot_of.get(termid, -1)))
+        j = int(np.searchsorted(self.dir2_termids, np.uint64(termid)))
+        if j < len(self.dir2_termids) and self.dir2_termids[j] == termid:
+            a, b = int(self.dir2_dstart[j]), int(self.dir2_dstart[j + 1])
+            if b > a:
+                # delta doc columns live at [Mb, Mb + n2)
+                out.append((False, self.Mb + a, b - a, -1))
         return out
 
     def _df_of(self, termid: int) -> int:
@@ -404,10 +583,11 @@ class DeviceIndex:
 
     def plan(self, qplan: QueryPlan) -> ResidentPlan:
         T = _bucket(max(len(qplan.groups), 1), T_FLOOR)
-        rows = []  # (is_base, a, b, group, slot_base, quota, syn)
+        drows, srows = [], []
         dfs = np.zeros(max(len(qplan.groups), 1), np.int64)
         matchable = True
-        req_idx = []
+        any_required = False
+        driver_df = 1 << 60
         for g_i, g in enumerate(qplan.groups):
             subs = g.sublists
             quota = max(self.P // max(len(subs), 1), 1)
@@ -415,72 +595,45 @@ class DeviceIndex:
             gdf = 0
             for s_i, sub in enumerate(subs):
                 syn = 1 if sub.kind == SUB_SYNONYM else 0
-                for is_base, a, b in self._runs_of(sub.termid):
-                    rows.append((is_base, a, b, g_i, s_i * quota, quota,
-                                 syn))
+                for is_base, a, ln, slot in self._druns_of(sub.termid):
+                    if slot >= 0:
+                        drows.append((slot, g_i, s_i * quota, quota, syn))
+                    else:
+                        srows.append((a, ln, g_i, s_i * quota, quota, syn,
+                                      is_base))
                     any_postings = True
-                # group df = max over sublists: exact for word+bigram
-                # groups (bigram docs ⊆ word docs by construction) —
-                # equals the host packer's np.unique union
                 gdf = max(gdf, self._df_of(sub.termid))
             dfs[g_i] = gdf
             if g.required and not g.negative:
-                req_idx.append(g_i)
+                any_required = True
+                driver_df = min(driver_df, gdf)
                 if not any_postings:
                     matchable = False
-        if not req_idx:
-            # no positive required group (pure-negative / empty query):
-            # nothing can match — the reference's early-out (Msg39)
+        if not any_required:
             matchable = False
-
-        # active tiles = tiles holding driver-group postings (driver =
-        # required group with fewest docs, setQueryTermInfo's rule)
-        tiles = np.empty(0, np.int64)
-        if matchable:
-            driver = min(req_idx, key=lambda i: dfs[i])
-            parts = []
-            for is_base, a, b, g_i, _sb, _q, _sy in rows:
-                if g_i != driver:
-                    continue
-                col = self.h_docidx if is_base else self.h2_docidx
-                parts.append(col[a:b] // self.TD)
-            tiles = np.unique(np.concatenate(parts)) if parts else tiles
-            if not len(tiles):
-                matchable = False
-
-        # per-(row, tile) run segments: runs are docidx-sorted, so a
-        # tile's slice is one searchsorted pair (RdbMap page walk)
-        R, NT = len(rows), len(tiles)
-        seg_start = np.zeros((R, NT), np.int32)
-        seg_len = np.zeros((R, NT), np.int32)
-        if NT:
-            lo = (tiles * self.TD).astype(np.int32)
-            hi = ((tiles + 1) * self.TD).astype(np.int32)
-            for r, (is_base, a, b, *_rest) in enumerate(rows):
-                col = self.h_docidx if is_base else self.h2_docidx
-                sl = col[a:b]
-                s = a + np.searchsorted(sl, lo)
-                e = a + np.searchsorted(sl, hi)
-                seg_start[r] = s
-                seg_len[r] = e - s
 
         required, negative, scored = group_flags(qplan, T)
         freqw = _pad1(
             weights.term_freq_weight(dfs[: len(qplan.groups)],
                                      max(self.coll.num_docs, 1)), T, 0.5)
-        arr = np.array([(g, sb, q, ib, sy) for ib, _a, _b, g, sb, q, sy
-                        in rows], np.int64).reshape(-1, 5) if rows else \
-            np.zeros((0, 5), np.int64)
+        da = np.array(drows, np.int64).reshape(-1, 5)
+        sa = np.array(srows, np.int64).reshape(-1, 7)
         return ResidentPlan(
-            tiles=tiles.astype(np.int32), seg_start=seg_start,
-            seg_len=seg_len,
-            group=arr[:, 0].astype(np.int32),
-            base=arr[:, 1].astype(np.int32),
-            quota=arr[:, 2].astype(np.int32),
-            is_base=arr[:, 3].astype(bool),
-            syn=arr[:, 4].astype(np.uint32),
+            d_slot=da[:, 0].astype(np.int32),
+            d_group=da[:, 1].astype(np.int32),
+            d_base=da[:, 2].astype(np.int32),
+            d_quota=da[:, 3].astype(np.int32),
+            d_syn=da[:, 4].astype(np.uint32),
+            s_start=sa[:, 0].astype(np.int32),
+            s_len=sa[:, 1].astype(np.int32),
+            s_group=sa[:, 2].astype(np.int32),
+            s_base=sa[:, 3].astype(np.int32),
+            s_quota=sa[:, 4].astype(np.int32),
+            s_syn=sa[:, 5].astype(np.uint32),
+            s_isbase=sa[:, 6].astype(bool),
             freq_weight=freqw, required=required, negative=negative,
-            scored=scored, qlang=qplan.lang, matchable=matchable)
+            scored=scored, qlang=qplan.lang, matchable=matchable,
+            driver_df=0 if driver_df == 1 << 60 else int(driver_df))
 
     # --- execution -------------------------------------------------------
 
@@ -490,74 +643,89 @@ class DeviceIndex:
 
     def search_batch(self, queries, topk: int = 64, lang: int = 0):
         """Batched execution: B queries in ONE device round trip (vmap
-        over the query axis), each scanning its active docid tiles."""
+        over the query axis), two-phase pruned scoring each."""
         qplans = [q if isinstance(q, QueryPlan) else compile_query(q, lang)
                   for q in queries]
         plans = [self.plan(qp) for qp in qplans]
-        live = [i for i, p in enumerate(plans)
-                if p.matchable and len(p.tiles) and len(p.group)]
+        live = [i for i, p in enumerate(plans) if p.matchable]
         results = [(np.empty(0, np.uint64), np.empty(0, np.float32), 0)
                    ] * len(plans)
         if not live:
             return results
-        # quantize shape buckets (powers of two) — every distinct
-        # (B, R, NT, L) tuple is an XLA compile; wasted lanes are masked
-        # compute, recompiles are 20-40s stalls
-        R = _bucket(max(len(plans[i].group) for i in live), R_FLOOR)
-        NT = _bucket(max(len(plans[i].tiles) for i in live), NT_FLOOR)
-        L = _bucket(max(int(plans[i].seg_len.max()) for i in live),
-                    L_FLOOR)
-        T = max(len(plans[i].required) for i in live)
-        B = _bucket(len(live), 4)
-        k = min(topk, self.D_cap)
+        kappa = min(_bucket(max(KAPPA_FLOOR, 2 * topk), KAPPA_FLOOR),
+                    self.D_cap)
+        k_req = min(topk, self.D_cap)
+        pending = live
+        while pending:
+            k2 = min(k_req, kappa)
+            out = self._run_batch([plans[i] for i in pending], kappa, k2)
+            escalate = []
+            for row, i in zip(out, pending):
+                nm = int(row[0])
+                ub_missed = float(np.asarray(row[1:2]).view(np.float32)[0])
+                idx = row[2:2 + k2].astype(np.int64)
+                scores = np.asarray(row[2 + k2:]).view(np.float32)
+                keep = scores > 0.0
+                kth = float(scores[k_req - 1]) if (k2 >= k_req
+                                                   and keep[k_req - 1]
+                                                   ) else 0.0
+                if ub_missed > kth * _TIE_TOL and kappa < self.D_cap:
+                    escalate.append(i)
+                    continue
+                results[i] = (
+                    self.all_docids[np.clip(idx[keep], 0,
+                                            max(self.n_docs - 1, 0))],
+                    scores[keep], nm)
+            if not escalate:
+                break
+            self.escalations += len(escalate)
+            pending = escalate
+            kappa = min(kappa * 4, self.D_cap)
+        return results
+
+    def _run_batch(self, plans: list[ResidentPlan], kappa: int, k2: int):
+        Rd = _bucket(max([len(p.d_slot) for p in plans] + [1]), RD_FLOOR)
+        Rs = _bucket(max([len(p.s_start) for p in plans] + [1]), RS_FLOOR)
+        Lsp = _bucket(max([int(p.s_len.max()) if len(p.s_len) else 1
+                           for p in plans] + [1]), LSP_FLOOR)
+        T = max(len(p.required) for p in plans)
+        B = _bucket(len(plans), B_FLOOR)
 
         def pad_plan(p: ResidentPlan | None):
-            if p is None:  # batch-padding lane: all-empty segments
-                return (np.zeros(NT, np.int32), np.zeros((R, NT), np.int32),
-                        np.zeros((R, NT), np.int32), np.zeros(R, np.int32),
-                        np.zeros(R, np.int32), np.ones(R, np.int32),
-                        np.ones(R, bool), np.zeros(R, np.uint32),
+            if p is None:
+                return (np.full(Rd, -1, np.int32), np.zeros(Rd, np.int32),
+                        np.zeros(Rd, np.int32), np.ones(Rd, np.int32),
+                        np.zeros(Rd, np.uint32),
+                        np.zeros(Rs, np.int32), np.zeros(Rs, np.int32),
+                        np.zeros(Rs, np.int32), np.zeros(Rs, np.int32),
+                        np.ones(Rs, np.int32), np.zeros(Rs, np.uint32),
+                        np.ones(Rs, bool),
                         np.full(T, 0.5, np.float32), np.zeros(T, bool),
-                        np.zeros(T, bool), np.zeros(T, bool),
-                        np.int32(0))
-            r, nt = p.seg_start.shape
-            tiles = np.zeros(NT, np.int32)
-            tiles[:nt] = p.tiles
-            ss = np.zeros((R, NT), np.int32)
-            ss[:r, :nt] = p.seg_start
-            sl = np.zeros((R, NT), np.int32)
-            sl[:r, :nt] = p.seg_len
-            pad1 = lambda a, fill: _pad1(a, R, fill)
-            return (tiles, ss, sl, pad1(p.group, 0), pad1(p.base, 0),
-                    pad1(p.quota, 1), pad1(p.is_base, True),
-                    pad1(p.syn, 0),
+                        np.zeros(T, bool), np.zeros(T, bool), np.int32(0))
+            pr = lambda a, n, fill: _pad1(a, n, fill)
+            return (pr(p.d_slot, Rd, -1), pr(p.d_group, Rd, 0),
+                    pr(p.d_base, Rd, 0), pr(p.d_quota, Rd, 1),
+                    pr(p.d_syn, Rd, 0),
+                    pr(p.s_start, Rs, 0), pr(p.s_len, Rs, 0),
+                    pr(p.s_group, Rs, 0), pr(p.s_base, Rs, 0),
+                    pr(p.s_quota, Rs, 1), pr(p.s_syn, Rs, 0),
+                    pr(p.s_isbase, Rs, True),
                     _pad1(p.freq_weight, T, 0.5),
                     _pad1(p.required, T, False),
                     _pad1(p.negative, T, False),
                     _pad1(p.scored, T, False), np.int32(p.qlang))
 
-        padded = [pad_plan(plans[i]) for i in live] \
-            + [pad_plan(None)] * (B - len(live))
-        args = [np.stack([p[j] for p in padded]) for j in range(13)]
+        padded = [pad_plan(p) for p in plans] \
+            + [pad_plan(None)] * (B - len(plans))
+        args = [np.stack([p[j] for p in padded]) for j in range(17)]
         dev_args = jax.device_put(args)
-        out = np.asarray(_resident_tiled(
-            self.d_docidx, self.d_payload, self.d2_docidx, self.d2_payload,
+        out = np.asarray(_two_phase(
+            self.d_payload, self.d_doc, self.d_imp, self.d_rsp,
+            self.d_dense_imp, self.d_dense_rsp,
             self.d_siterank, self.d_doclang, self.d_dead,
             np.int32(self.n_docs), *dev_args,
-            tile_docs=self.TD, n_positions=self.P, run_l=L, n_groups=T,
-            topk=k))  # [B, 1 + 2k]
-
-        for b, i in enumerate(live):
-            row = out[b]
-            n_matched = int(row[0])
-            idx = row[1:1 + k].astype(np.int64)
-            scores = row[1 + k:].view(np.float32)
-            keep = scores > 0.0
-            results[i] = (
-                self.all_docids[np.clip(idx[keep], 0,
-                                        max(self.n_docs - 1, 0))],
-                scores[keep], n_matched)
-        return results
+            n_positions=self.P, lsp=Lsp, kappa=kappa, k2=k2))
+        return out
 
 
 @jax.jit
@@ -565,97 +733,154 @@ def _apply_doc_meta(sr, dl, idx, vsr, vdl):
     return sr.at[idx].set(vsr), dl.at[idx].set(vdl)
 
 
-@jax.jit
-def _apply_dead(dead, idx):
-    return dead.at[idx].set(True)
+@partial(jax.jit, static_argnames=("n_positions", "lsp", "kappa", "k2"))
+def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
+               d_siterank, d_doclang, d_dead, n_docs_total,
+               d_slot, d_group, d_base, d_quota, d_syn,
+               s_start, s_len, s_group, s_base, s_quota, s_syn, s_isbase,
+               freqw, required, negative, scored, qlang,
+               n_positions: int, lsp: int, kappa: int, k2: int):
+    """The fused two-phase kernel, vmapped over the query axis.
 
+    Phase 1 = dense upper bounds + intersection + approx top-κ (the
+    maxPossibleScore prune, Posdb.cpp:6052); phase 2 = exact cube scoring
+    of the κ candidates (docIdLoop semantics via scorer.min_scores).
+    Output per query: [n_matched, bitcast(max missed bound), κ-top-k2
+    doc indices, bitcast(exact scores)]."""
+    D = d_dead.shape[0]
+    V = d_dense_imp.shape[0]
+    M = d_doc.shape[0]
+    N = d_payload.shape[0]
+    P = n_positions
+    big = jnp.float32(9.99e8)
 
-@partial(jax.jit,
-         static_argnames=("tile_docs", "n_positions", "run_l", "n_groups",
-                          "topk"))
-def _resident_tiled(d_docidx, d_payload, d2_docidx, d2_payload,
-                    d_siterank, d_doclang, d_dead, n_docs_total,
-                    tiles, seg_start, seg_len, group, base, quota,
-                    is_base, syn, freqw, required, negative, scored, qlang,
-                    tile_docs: int, n_positions: int, run_l: int,
-                    n_groups: int, topk: int):
-    """vmapped tiled kernel: scan docid tiles, gather run segments →
-    rank → cube → score → running top-k merge (the docid-range multipass
-    of Msg39.cpp:277 fused into one program)."""
-    from .scorer import scatter_cube, score_cube
-
-    TD = tile_docs
-    L = run_l
-    Nb = d_docidx.shape[0]
-    Nd = d2_docidx.shape[0]
-    Dc = d_dead.shape[0]
-    k_tile = min(topk, TD)
-
-    def one(tiles, seg_start, seg_len, group, base, quota, is_base, syn,
+    def one(d_slot, d_group, d_base, d_quota, d_syn,
+            s_start, s_len, s_group, s_base, s_quota, s_syn, s_isbase,
             freqw, required, negative, scored, qlang):
-        lane = jnp.arange(L, dtype=jnp.int32)[None, :]
+        T = required.shape[0]
+        Rd = d_slot.shape[0]
+        Rs = s_start.shape[0]
+        t_ax = jnp.arange(T)
+        live = ~d_dead                                        # [D]
 
-        def tile_step(carry, xs):
-            bs, bi, nm = carry
-            tile_id, s0, sl = xs            # [], [R], [R]
-            base_doc = tile_id * TD
-            idx = s0[:, None] + lane
-            gb = d_docidx[jnp.clip(idx, 0, Nb - 1)]
-            gd = d2_docidx[jnp.clip(idx, 0, Nd - 1)]
-            docg = jnp.where(is_base[:, None], gb, gd)
-            pb = d_payload[jnp.clip(idx, 0, Nb - 1)]
-            pd = d2_payload[jnp.clip(idx, 0, Nd - 1)]
-            pay = (jnp.where(is_base[:, None], pb, pd)
-                   | syn[:, None] << jnp.uint32(31))
-            inlane = lane < sl[:, None]                     # [R, L]
-            dead = d_dead[jnp.clip(docg, 0, Dc - 1)]
-            # tombstoned docs mask only their BASE postings; a re-added
-            # doc's fresh postings live in the delta and stay valid
-            valid = inlane & ~(dead & is_base[:, None])
-            docrow = jnp.where(inlane, docg - base_doc, TD)
-            # occurrence rank within each (row, doc): rows are
-            # docidx-sorted, so first-index-of-run is a running max over
-            # change markers — an O(L) associative scan
-            change = jnp.concatenate(
-                [jnp.ones((docrow.shape[0], 1), bool),
-                 docrow[:, 1:] != docrow[:, :-1]], axis=1)
-            first = jax.lax.associative_scan(
-                jnp.maximum,
-                jnp.where(change, jnp.broadcast_to(lane, change.shape), 0),
-                axis=1)
-            rank = lane - first
-            slot = base[:, None] + rank
-            valid = valid & (rank < quota[:, None])
-            # dead lanes go to the drop row so their scatters can never
-            # land in a sibling sublist's live slots (duplicate-index
-            # scatter order is implementation-defined on TPU)
-            docrow = jnp.where(valid, docrow, TD)
-            cube, pvalid = scatter_cube(docrow, pay, slot, valid, TD,
-                                        n_positions, row_group=group,
-                                        n_groups=n_groups)
-            sr = jax.lax.dynamic_slice(d_siterank, (base_doc,), (TD,))
-            dl = jax.lax.dynamic_slice(d_doclang, (base_doc,), (TD,))
-            n_in = jnp.clip(n_docs_total - base_doc, 0, TD)
-            nmt, ts, ti = score_cube(
-                cube, pvalid, freqw, required, negative, scored,
-                sr, dl, qlang, n_in, topk=k_tile)
-            cs = jnp.concatenate([bs, ts])
-            ci = jnp.concatenate([bi, (base_doc + ti).astype(jnp.int32)])
-            nbs, sel = jax.lax.top_k(cs, topk)
-            return (nbs, ci[sel], nm + nmt.astype(jnp.int32)), None
+        # ---- phase 1: group upper bounds over the full doc axis,
+        # base and delta separated so dead docs mask only the base ----
+        ubb = jnp.zeros((T, D), jnp.float32)
+        dimp = d_dense_imp[jnp.clip(d_slot, 0, V - 1)]        # [Rd, D]
+        dgate = (d_slot >= 0)
+        for r in range(Rd):
+            contrib = jnp.where(dgate[r], dimp[r], 0.0)
+            ubb = ubb + jnp.where((d_group[r] == t_ax)[:, None],
+                                  contrib[None, :], 0.0)
+        # sparse rows: one fused contiguous gather + bounded scatter-add
+        # into [2 (base/delta), T, D] — lane count is the real run size
+        lane = jnp.arange(lsp, dtype=jnp.int32)
+        sidx = s_start[:, None] + lane[None, :]               # [Rs, Lsp]
+        smask = lane[None, :] < s_len[:, None]
+        sidxc = jnp.clip(sidx, 0, M - 1)
+        sdoc = d_doc[sidxc]
+        simp = d_imp[sidxc]
+        srsp = d_rsp[sidxc]
+        side = jnp.where(s_isbase, 0, T * D)[:, None]         # [Rs, 1]
+        tgt = jnp.where(smask, side + s_group[:, None] * D + sdoc,
+                        2 * T * D)
+        ub2 = jnp.zeros((2 * T * D,), jnp.float32).at[tgt.ravel()].add(
+            jnp.where(smask, simp, 0.0).ravel(), mode="drop"
+        ).reshape(2, T, D)
+        ubb = ubb + ub2[0]
+        ubd = ub2[1]
+        ub = ubb * live[None, :] + ubd                        # [T, D]
+        rstgt = jnp.where(
+            smask, jnp.arange(Rs, dtype=jnp.int32)[:, None] * D + sdoc,
+            Rs * D)
+        rsacc = jnp.zeros((Rs * D,), jnp.int32).at[rstgt.ravel()].set(
+            jnp.where(smask, srsp, 0).ravel(), mode="drop")
 
-        init = (jnp.zeros((topk,), jnp.float32),
-                jnp.zeros((topk,), jnp.int32), jnp.zeros((), jnp.int32))
-        (bs, bi, nm), _ = jax.lax.scan(
-            tile_step, init,
-            (tiles, jnp.moveaxis(seg_start, 1, 0),
-             jnp.moveaxis(seg_len, 1, 0)))
+        # intersection + admissible min bound
+        present = ub > 0.0                                    # [T, D]
+        sc = scored & required
+        ubw = ub * (freqw * freqw)[:, None]
+        req_ok = jnp.all(jnp.where(required[:, None], present, True),
+                         axis=0)
+        neg_ok = ~jnp.any(jnp.where(negative[:, None], present, False),
+                          axis=0)
+        alive = req_ok & neg_ok & (jnp.arange(D) < n_docs_total)
+        m1 = present & sc[:, None]
+        min_single_ub = jnp.min(jnp.where(m1, ubw, big), axis=0)
+        min_pair_ub = jnp.full((D,), big)
+        any_pair = jnp.zeros((D,), bool)
+        for i in range(T):
+            for j in range(i + 1, T):
+                ok = present[i] & present[j] & sc[i] & sc[j]
+                pu = jnp.sqrt(ubw[i] * ubw[j])
+                min_pair_ub = jnp.where(ok, jnp.minimum(min_pair_ub, pu),
+                                        min_pair_ub)
+                any_pair = any_pair | ok
+        ubmin = jnp.minimum(jnp.where(any_pair, min_pair_ub, big),
+                            min_single_ub)
+        ubmin = jnp.where(jnp.any(sc), ubmin, 1.0)
+        mult = final_multipliers(d_siterank, d_doclang, qlang)
+        ubfinal = jnp.where(alive, ubmin * mult * 1.00001, 0.0)
+        nm = jnp.sum(alive)
+
+        cval, cand = jax.lax.approx_max_k(ubfinal, kappa)
+        selmask = jnp.zeros((D,), bool).at[cand].set(True)
+        ub_missed = jnp.max(jnp.where(selmask, 0.0, ubfinal))
+
+        # ---- phase 2: exact scoring of the κ candidates ----
+        dead_c = d_dead[cand]                                 # [κ]
+        p_ax = jnp.arange(P, dtype=jnp.int32)[:, None]        # [P, 1]
+        cube = jnp.zeros((T, P, kappa), jnp.uint32)
+        pv = jnp.zeros((T, P, kappa), bool)
+
+        def add_row(cube, pv, rsp_c, group, base, quota, syn, is_base):
+            rs = (rsp_c >> _RS_SHIFT).astype(jnp.int32)       # [κ]
+            cnt = rsp_c & _CNT_MASK
+            cnt = jnp.where(is_base & dead_c, 0, cnt)
+            q = p_ax - base                                   # [P, κ]
+            sel = (q >= 0) & (q < jnp.minimum(cnt, quota)[None, :])
+            src = rs[None, :] + q
+            val = (d_payload[jnp.clip(src, 0, N - 1)]
+                   | (syn.astype(jnp.uint32) << jnp.uint32(31)))
+            gmask = (group == t_ax)[:, None, None]            # [T, 1, 1]
+            cube = cube + jnp.where(sel, val, jnp.uint32(0))[None] \
+                * gmask.astype(jnp.uint32)
+            pv = pv | (sel[None] & gmask)
+            return cube, pv
+
+        dense_rsp_c = d_dense_rsp[
+            jnp.clip(d_slot, 0, V - 1)[:, None] * D + cand[None, :]]
+        for r in range(Rd):
+            rsp_c = jnp.where(dgate[r], dense_rsp_c[r], 0)
+            cube, pv = add_row(cube, pv, rsp_c, d_group[r], d_base[r],
+                               d_quota[r], d_syn[r], True)
+        for r in range(Rs):
+            rsp_c = rsacc[r * D + cand]
+            cube, pv = add_row(cube, pv, rsp_c, s_group[r], s_base[r],
+                               s_quota[r], s_syn[r], s_isbase[r])
+
+        min_sc, present2 = min_scores(cube, pv, freqw, sc)
+        req_ok2 = jnp.all(jnp.where(required[:, None], present2, True),
+                          axis=0)
+        neg_ok2 = ~jnp.any(jnp.where(negative[:, None], present2, False),
+                           axis=0)
+        match2 = req_ok2 & neg_ok2 & (cval > 0.0) & (min_sc < big)
+        final = jnp.where(
+            match2,
+            min_sc * final_multipliers(d_siterank[cand], d_doclang[cand],
+                                       qlang),
+            0.0)
+        ts, tl = jax.lax.top_k(final, k2)
+        ti = cand[tl]
         return jnp.concatenate([
             jnp.atleast_1d(nm.astype(jnp.uint32)),
-            bi.astype(jnp.uint32),
-            jax.lax.bitcast_convert_type(bs, jnp.uint32),
+            jax.lax.bitcast_convert_type(jnp.atleast_1d(ub_missed),
+                                         jnp.uint32),
+            ti.astype(jnp.uint32),
+            jax.lax.bitcast_convert_type(ts, jnp.uint32),
         ])
 
-    return jax.vmap(one)(tiles, seg_start, seg_len, group, base, quota,
-                         is_base, syn, freqw, required, negative, scored,
+    return jax.vmap(one)(d_slot, d_group, d_base, d_quota, d_syn,
+                         s_start, s_len, s_group, s_base, s_quota, s_syn,
+                         s_isbase, freqw, required, negative, scored,
                          qlang)

@@ -32,6 +32,14 @@ excluded there but covered by the body path here. qdist=2 subtracted when
 
 Everything here is shape-static; the packer buckets (T, L, D) to powers of
 two so the jit cache stays small.
+
+Memory layout (TPU-critical): the cube is ``[T, P, D]`` with the doc axis
+**minor**. The TPU vector unit tiles the two minor dimensions to (8, 128);
+with D minor every elementwise op runs on full lanes, and the per-pair
+position cross products become ``[P, P, D]`` — again D minor, fully
+vectorized. The transposed ``[D, T, P]`` layout (P=16 minor) pads 16→128
+lanes and 4→8 sublanes, i.e. ~16× wasted HBM traffic on every op in the
+scoring chain — measured ~10× slower end-to-end on v5e.
 """
 
 from __future__ import annotations
@@ -61,10 +69,10 @@ def scatter_cube(doc_idx, payload, slot, valid, n_docs_padded: int,
                  n_positions: int, row_group=None, n_groups: int | None
                  = None):
     """Scatter posting rows into the dense position cube
-    ``[D, n_groups, P]`` (+ validity). ``row_group`` maps each row of
-    ``doc_idx`` to its term group — identity when rows ARE groups (the
-    host-packed path); the device-resident path gathers one row per
-    *sublist* and folds them into groups here (the mini-merge,
+    ``[n_groups, P, D]`` (+ validity; doc axis minor). ``row_group`` maps
+    each row of ``doc_idx`` to its term group — identity when rows ARE
+    groups (the host-packed path); the device-resident path gathers one
+    row per *sublist* and folds them into groups here (the mini-merge,
     ``Posdb.cpp`` miniMergeBuf, as a scatter index)."""
     R, L = doc_idx.shape
     D = n_docs_padded
@@ -74,67 +82,73 @@ def scatter_cube(doc_idx, payload, slot, valid, n_docs_padded: int,
         g_of = jnp.broadcast_to(jnp.arange(R)[:, None], (R, L))
     else:
         g_of = jnp.broadcast_to(row_group[:, None], (R, L))
-    cube = jnp.zeros((D + 1, T, P), jnp.uint32)
-    cube = cube.at[doc_idx, g_of, slot].set(payload, mode="drop")
-    pvalid = jnp.zeros((D + 1, T, P), jnp.bool_)
-    pvalid = pvalid.at[doc_idx, g_of, slot].set(valid, mode="drop")
-    return cube[:D], pvalid[:D]
+    cube = jnp.zeros((T, P, D + 1), jnp.uint32)
+    cube = cube.at[g_of, slot, doc_idx].set(payload, mode="drop")
+    pvalid = jnp.zeros((T, P, D + 1), jnp.bool_)
+    pvalid = pvalid.at[g_of, slot, doc_idx].set(valid, mode="drop")
+    return cube[..., :D], pvalid[..., :D]
 
 
-def score_cube(cube, pvalid, freq_weight, required, negative, scored,
-               siterank, doclang, qlang, n_docs, topk: int = 64):
-    """Score the dense position cube — the docIdLoop replacement.
+def position_weights(cube, pvalid):
+    """Decode payloads → (posscore, posw, wordpos, hg) in [T, P, D].
 
-    Shapes: cube/pvalid [D, T, P]; freq_weight/required/negative/scored
-    [T]; siterank/doclang [D]; qlang/n_docs scalars. Returns (match
-    count, top scores [k], top doc indices [k]).
-    """
-    D, T, P = cube.shape
-
+    posw is the per-position weight product (hashgroup × density × spam ×
+    synonym — the initWeights tables); posscore applies it squared on
+    BASE_SCORE (singles square the weight, pairs take one factor per
+    side — Posdb.cpp:3118)."""
     wordpos, hg, den, spam, syn = _decode(cube)
-
-    # ---- per-position weights (each later applied squared for singles,
-    #      once per side for pairs — exactly the reference tables) ----
     hgw = jnp.asarray(weights.HASH_GROUP_WEIGHTS)[hg]
     denw = jnp.asarray(weights.DENSITY_WEIGHTS)[den]
     spamw = jnp.where(hg == HASHGROUP_INLINKTEXT,
                       jnp.asarray(weights.LINKER_WEIGHTS)[spam],
                       jnp.asarray(weights.WORD_SPAM_WEIGHTS)[spam])
     synw = jnp.where(syn == 1, weights.SYNONYM_WEIGHT, 1.0)
-    posw = hgw * denw * spamw * synw                       # [D, T, P]
+    posw = hgw * denw * spamw * synw                       # [T, P, D]
     posscore = weights.BASE_SCORE * posw * posw * pvalid   # squared weights
+    return posscore, posw, wordpos, hg
 
-    present = jnp.any(pvalid, axis=-1)                     # [D, T]
+
+def min_scores(cube, pvalid, freq_weight, single_counts):
+    """The docIdLoop scoring core on a [T, P, D] cube: returns
+    (min_score [D] before multipliers, present [T, D]).
+
+    ``single_counts`` [T]: groups participating in the min (scored &
+    required, negatives excluded)."""
+    T, P, D = cube.shape
+    posscore, posw, wordpos, hg = position_weights(cube, pvalid)
+    present = jnp.any(pvalid, axis=1)                      # [T, D]
 
     # ---- single-term scores (getSingleTermScore) ----
     # dedup by mapped hashgroup: one best position per collapsed group,
     # except INLINKTEXT where every occurrence competes individually
-    mhg = jnp.asarray(weights.MAPPED_HASHGROUP)[hg]        # [D, T, P]
+    mhg = jnp.asarray(weights.MAPPED_HASHGROUP)[hg]        # [T, P, D]
     is_inlink = hg == HASHGROUP_INLINKTEXT
-    grp_onehot = jax.nn.one_hot(mhg, HASHGROUP_END, dtype=posscore.dtype)
-    grp_max = jnp.max(posscore[..., None] * grp_onehot, axis=-2)  # [D,T,G]
-    grp_max = grp_max.at[..., HASHGROUP_INLINKTEXT].set(0.0)
-    inlink_scores = jnp.where(is_inlink, posscore, 0.0)    # [D, T, P]
-    cand = jnp.concatenate([grp_max, inlink_scores], axis=-1)
-    top_vals, _ = jax.lax.top_k(cand, min(weights.MAX_TOP, cand.shape[-1]))
-    single = jnp.sum(top_vals, axis=-1) * freq_weight * freq_weight  # [D,T]
+    grp_max = [
+        jnp.max(jnp.where(mhg == g, posscore, 0.0), axis=1)
+        if g != HASHGROUP_INLINKTEXT else jnp.zeros((T, D), posscore.dtype)
+        for g in range(HASHGROUP_END)]                     # G × [T, D]
+    inlink_scores = jnp.where(is_inlink, posscore, 0.0)    # [T, P, D]
+    cand = jnp.concatenate(
+        [jnp.stack(grp_max, axis=1), inlink_scores], axis=1)  # [T, G+P, D]
+    k10 = min(weights.MAX_TOP, cand.shape[1])
+    top_sum = jnp.sum(jnp.sort(cand, axis=1)[:, -k10:, :], axis=1)
+    single = top_sum * (freq_weight * freq_weight)[:, None]  # [T, D]
 
     big = jnp.float32(9.99e8)  # reference's 999999999.0 sentinel
-    single_counts = scored & required  # scoring skips negatives/filters
-    s_mask = present & single_counts[None, :]
-    min_single = jnp.min(jnp.where(s_mask, single, big), axis=-1)   # [D]
+    s_mask = present & single_counts[:, None]
+    min_single = jnp.min(jnp.where(s_mask, single, big), axis=0)    # [D]
 
     # ---- pair scores: exact max over P×P per (i, j) ----
-    in_body = jnp.asarray(weights.IN_BODY)[hg]             # [D, T, P]
+    in_body = jnp.asarray(weights.IN_BODY)[hg]             # [T, P, D]
     min_pair = jnp.full((D,), big)
     any_pair = jnp.zeros((D,), jnp.bool_)
     for i in range(T):
         for j in range(i + 1, T):
-            delta = (wordpos[:, j, None, :]
-                     - wordpos[:, i, :, None]).astype(jnp.float32)
-            d_plain = jnp.maximum(jnp.abs(delta), 2.0)
-            body_i = in_body[:, i, :, None]
-            body_j = in_body[:, j, None, :]
+            delta = (wordpos[j][None, :, :]
+                     - wordpos[i][:, None, :]).astype(jnp.float32)
+            d_plain = jnp.maximum(jnp.abs(delta), 2.0)     # [P, P, D]
+            body_i = in_body[i][:, None, :]
+            body_j = in_body[j][None, :, :]
             mixed = body_i != body_j
             both_nb = (~body_i) & (~body_j)
             d_base = jnp.where(
@@ -143,13 +157,13 @@ def score_cube(cube, pvalid, freq_weight, required, negative, scored,
             d_adj = (jnp.where(d_base >= QDIST, d_base - QDIST, d_base)
                      + (delta < 0))
             dist = jnp.where(mixed, float(weights.FIXED_DISTANCE), d_adj)
-            pv = (pvalid[:, i, :, None] & pvalid[:, j, None, :])
+            pv = (pvalid[i][:, None, :] & pvalid[j][None, :, :])
             ps = (weights.BASE_SCORE
-                  * posw[:, i, :, None] * posw[:, j, None, :]
+                  * posw[i][:, None, :] * posw[j][None, :, :]
                   / (dist + 1.0)) * pv
-            best = jnp.max(ps, axis=(-2, -1))              # [D]
+            best = jnp.max(ps, axis=(0, 1))                # [D]
             wts = best * freq_weight[i] * freq_weight[j]
-            pair_ok = (present[:, i] & present[:, j]
+            pair_ok = (present[i] & present[j]
                        & single_counts[i] & single_counts[j])
             min_pair = jnp.where(pair_ok, jnp.minimum(min_pair, wts),
                                  min_pair)
@@ -160,22 +174,41 @@ def score_cube(cube, pvalid, freq_weight, required, negative, scored,
     # min, so matching docs score a constant 1.0 before multipliers
     has_scoring = jnp.any(single_counts)
     min_score = jnp.where(has_scoring, min_score, 1.0)
+    return min_score, present
 
-    # ---- match mask: every required group present, no negative present,
-    #      inside the real (unpadded) candidate range ----
-    req_ok = jnp.all(jnp.where(required[None, :], present, True), axis=-1)
-    neg_ok = ~jnp.any(jnp.where(negative[None, :], present, False), axis=-1)
-    in_range = jnp.arange(D) < n_docs
-    match = req_ok & neg_ok & in_range & (min_score < big)
 
-    # ---- final score (Posdb.cpp:7250-7257) ----
+def final_multipliers(siterank, doclang, qlang):
+    """Siterank/language multipliers (Posdb.cpp:7250-7257), [D]."""
     lang_mult = jnp.where(
         (qlang == 0) | (doclang == 0) | (doclang == qlang),
         weights.SAME_LANG_WEIGHT, 1.0)
-    final = (min_score
-             * (siterank.astype(jnp.float32) * weights.SITERANKMULTIPLIER
-                + 1.0)
-             * lang_mult)
+    return (siterank.astype(jnp.float32) * weights.SITERANKMULTIPLIER
+            + 1.0) * lang_mult
+
+
+def score_cube(cube, pvalid, freq_weight, required, negative, scored,
+               siterank, doclang, qlang, n_docs, topk: int = 64):
+    """Score the dense position cube — the docIdLoop replacement.
+
+    Shapes: cube/pvalid [T, P, D] (doc axis minor);
+    freq_weight/required/negative/scored [T]; siterank/doclang [D];
+    qlang/n_docs scalars. Returns (match count, top scores [k], top doc
+    indices [k]).
+    """
+    T, P, D = cube.shape
+    big = jnp.float32(9.99e8)
+    single_counts = scored & required  # scoring skips negatives/filters
+    min_score, present = min_scores(cube, pvalid, freq_weight,
+                                    single_counts)
+
+    # ---- match mask: every required group present, no negative present,
+    #      inside the real (unpadded) candidate range ----
+    req_ok = jnp.all(jnp.where(required[:, None], present, True), axis=0)
+    neg_ok = ~jnp.any(jnp.where(negative[:, None], present, False), axis=0)
+    in_range = jnp.arange(D) < n_docs
+    match = req_ok & neg_ok & in_range & (min_score < big)
+
+    final = min_score * final_multipliers(siterank, doclang, qlang)
     final = jnp.where(match, final, 0.0)
 
     k = min(topk, D)

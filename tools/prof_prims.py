@@ -1,0 +1,148 @@
+"""Measure TPU primitive throughput: gather variants, scatter, top_k, dense ops."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+rng = np.random.default_rng(0)
+
+
+def timeit(fn, *args, n=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def report(name, secs, n_elem, bytes_per=4):
+    print(f"{name}: {1000*secs:.2f} ms  ({n_elem/secs/1e6:.0f} Melem/s, "
+          f"{n_elem*bytes_per/secs/1e9:.1f} GB/s)", file=sys.stderr)
+
+
+def main():
+    print("devices:", jax.devices(), file=sys.stderr)
+    N = 1 << 25
+    src = jax.device_put(rng.integers(0, 2**31, N, dtype=np.int64).astype(np.int32))
+
+    M = 8_000_000
+    idx_rand = jax.device_put(rng.integers(0, N, M).astype(np.int32))
+    idx_seq0 = rng.integers(0, N - 4096, M // 4096).astype(np.int32)
+    idx_seq = jax.device_put((idx_seq0[:, None] + np.arange(4096, dtype=np.int32)).reshape(-1))
+
+    f_gather = jax.jit(lambda i: src[i].sum())
+    report("gather random scalar [8M]", timeit(f_gather, idx_rand), M)
+    report("gather contiguous-runs scalar [8M]", timeit(f_gather, idx_seq), M)
+
+    # block gather: reshape source into 128-lane rows, gather rows
+    src2 = src.reshape(-1, 128)
+    Mb = M // 128
+    bidx = jax.device_put(rng.integers(0, N // 128, Mb).astype(np.int32))
+    f_block = jax.jit(lambda i: src2[i].sum())
+    report("gather random 128-blocks [8M elems]", timeit(f_block, bidx), M)
+
+    src2b = src.reshape(-1, 512)
+    Mb2 = M // 512
+    bidx2 = jax.device_put(rng.integers(0, N // 512, Mb2).astype(np.int32))
+    f_block2 = jax.jit(lambda i: src2b[i].sum())
+    report("gather random 512-blocks [8M elems]", timeit(f_block2, bidx2), M)
+
+    # vmapped dynamic_slice (contiguous segments)
+    S = 256
+    LS = 32768
+    starts = jax.device_put(rng.integers(0, N - LS, S).astype(np.int32))
+    f_ds = jax.jit(lambda st: jax.vmap(
+        lambda s: jax.lax.dynamic_slice(src, (s,), (LS,)).sum())(st))
+    report(f"vmapped dynamic_slice [{S}x{LS}]", timeit(f_ds, starts), S * LS)
+
+    # scan of dynamic_slice
+    f_scan = jax.jit(lambda st: jax.lax.scan(
+        lambda c, s: (c + jax.lax.dynamic_slice(src, (s,), (LS,)).sum(), None),
+        jnp.int32(0), st)[0])
+    report(f"scan dynamic_slice [{S}x{LS}]", timeit(f_scan, starts), S * LS)
+
+    # scatter random set
+    Msc = 2_000_000
+    sidx = jax.device_put(rng.integers(0, N, Msc).astype(np.int32))
+    vals = jax.device_put(rng.integers(0, 100, Msc).astype(np.int32))
+    f_scat = jax.jit(lambda i, v: src.at[i].set(v, mode="drop").sum())
+    report("scatter random set [2M]", timeit(f_scat, sidx, vals), Msc)
+
+    # scatter into small dest (cube-like)
+    dest_small = jnp.zeros((2048 * 4 * 16,), jnp.int32)
+    sidx2 = jax.device_put(rng.integers(0, 2048 * 4 * 16, Msc).astype(np.int32))
+    f_scat2 = jax.jit(lambda i, v: dest_small.at[i].set(v, mode="drop").sum())
+    report("scatter random set into 131k dest [2M]", timeit(f_scat2, sidx2, vals), Msc)
+
+    # top_k over large minor dim
+    B = 32
+    D = 131072
+    x = jax.device_put(rng.random((B, D), dtype=np.float32))
+    f_topk = jax.jit(lambda x: jax.lax.top_k(x, 64)[0].sum())
+    report(f"top_k(64) over [{B},{D}]", timeit(f_topk, x), B * D)
+
+    # argsort-based alternative for top-k
+    f_sortk = jax.jit(lambda x: jax.lax.sort(x, dimension=1)[:, -64:].sum())
+    report(f"full sort over [{B},{D}]", timeit(f_sortk, x), B * D)
+
+    # dense elementwise chain on [B, T, P, D] layout (D minor)
+    T, P, Dt = 4, 16, 2048
+    cube = jax.device_put(
+        rng.integers(0, 2**31, (B, T, P, Dt), dtype=np.int64).astype(np.uint32))
+
+    @jax.jit
+    def dense_chain(c):
+        wp = (c & jnp.uint32(0x3FFFF)).astype(jnp.int32)
+        hg = ((c >> jnp.uint32(18)) & jnp.uint32(0xF)).astype(jnp.int32)
+        w = jnp.asarray(np.linspace(0, 1, 16, dtype=np.float32))[hg]
+        s = w * w * 1000.0
+        m = jnp.max(s, axis=2)
+        return m.sum() + wp.sum()
+
+    report(f"dense decode+weight [B,{T},{P},{Dt}] (D minor)", timeit(dense_chain, cube),
+           B * T * P * Dt)
+
+    # same chain on [B, D, T, P] layout (P minor=16)
+    cube2 = jax.device_put(
+        rng.integers(0, 2**31, (B, Dt, T, P), dtype=np.int64).astype(np.uint32))
+
+    @jax.jit
+    def dense_chain2(c):
+        wp = (c & jnp.uint32(0x3FFFF)).astype(jnp.int32)
+        hg = ((c >> jnp.uint32(18)) & jnp.uint32(0xF)).astype(jnp.int32)
+        w = jnp.asarray(np.linspace(0, 1, 16, dtype=np.float32))[hg]
+        s = w * w * 1000.0
+        m = jnp.max(s, axis=3)
+        return m.sum() + wp.sum()
+
+    report(f"dense decode+weight [B,{Dt},{T},{P}] (P minor)", timeit(dense_chain2, cube2),
+           B * T * P * Dt)
+
+    # pair-score-like cross product [P,P,D] vs [D,P,P]
+    wpA = jax.device_put(rng.integers(0, 2**18, (B, P, Dt)).astype(np.int32))
+
+    @jax.jit
+    def pair_tpd(wp):
+        d = (wp[:, None, :, :] - wp[:, :, None, :]).astype(jnp.float32)
+        return jnp.max(1000.0 / (jnp.abs(d) + 1.0), axis=(1, 2)).sum()
+
+    report(f"pair cross [B,{P},{P},{Dt}] (D minor)", timeit(pair_tpd, wpA),
+           B * P * P * Dt)
+
+    wpB = jax.device_put(rng.integers(0, 2**18, (B, Dt, P)).astype(np.int32))
+
+    @jax.jit
+    def pair_dpp(wp):
+        d = (wp[:, :, None, :] - wp[:, :, :, None]).astype(jnp.float32)
+        return jnp.max(1000.0 / (jnp.abs(d) + 1.0), axis=(2, 3)).sum()
+
+    report(f"pair cross [B,{Dt},{P},{P}] (P minor)", timeit(pair_dpp, wpB),
+           B * P * P * Dt)
+
+
+if __name__ == "__main__":
+    main()
