@@ -1,14 +1,24 @@
-"""Benchmark: query throughput on one device vs the reference baseline.
+"""Benchmark: query throughput + p50 latency vs the reference baseline.
 
 Reference baseline (BASELINE.md / ``html/faq.html:320``): ~8 queries/sec
 on a 10M-page index on 2010-era hardware (dual quad-core, 8 gb
-instances). BASELINE.json's measurable config here: conjunctive AND +
-single-term queries over a synthetic corpus on one chip — the
-``PosdbTable::intersectLists10_r`` path (device kernel) plus the host
-pack (Msg2 equivalent).
+instances). BASELINE.json's measurable config: conjunctive AND +
+single-term queries on one chip — the ``PosdbTable::intersectLists10_r``
+path (two-phase device kernel) plus the host plan (Msg2 equivalent).
+
+Honesty notes:
+* the corpus is built through the REAL indexing pipeline (HTML →
+  tokenizer → posdb keys → Rdb), then dumped so the measured queries
+  exercise the on-disk base path (dense impact rows + materialized cube
+  rows + a small live delta) — not a memtable-only toy;
+* every measured query string is UNIQUE — the tunneled TPU backend can
+  serve repeated identical dispatches from a cache, which would fake
+  the throughput number;
+* p50 single-query latency is measured on warmed shape buckets
+  (compiles excluded; the cache warmup cost is reported on stderr).
 
 Prints exactly ONE JSON line:
-``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}``.
 """
 
 from __future__ import annotations
@@ -23,87 +33,128 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_QPS = 8.0  # html/faq.html:320
 
-N_DOCS = int(os.environ.get("BENCH_DOCS", "2000"))
-N_QUERIES = int(os.environ.get("BENCH_QUERIES", "200"))
+N_DOCS = int(os.environ.get("BENCH_DOCS", "100000"))
+N_QUERIES = int(os.environ.get("BENCH_QUERIES", "512"))
+BATCH = int(os.environ.get("BENCH_BATCH", "32"))
+N_LAT = int(os.environ.get("BENCH_LAT_QUERIES", "24"))
+VOCAB = 2000
 
 
-def _build_corpus(coll, n_docs: int) -> list[str]:
-    """Synthetic zipf-vocabulary corpus; returns the vocabulary."""
+def _gen_docs(n_docs: int):
+    """Synthetic zipf-vocabulary HTML corpus (deterministic)."""
     import numpy as np
-
-    from open_source_search_engine_tpu.build import docproc
 
     rng = np.random.default_rng(42)
-    vocab = [f"word{i}" for i in range(2000)]
-    varr = np.array(vocab)
+    varr = np.array([f"word{i}" for i in range(VOCAB)])
     for d in range(n_docs):
         n_words = int(rng.integers(60, 220))
-        idx = rng.zipf(1.35, size=n_words) % len(vocab)
+        idx = rng.zipf(1.35, size=n_words) % VOCAB
         words = varr[idx]
         title = " ".join(words[:4])
-        sents = []
-        for s in range(0, n_words, 12):
-            sents.append(" ".join(words[s:s + 12]) + ".")
-        docproc.index_document(
-            coll, f"http://site{d % 97}.bench.test/doc{d}",
-            f"<html><head><title>{title}</title></head><body><p>"
-            + " ".join(sents) + "</p></body></html>")
-    return vocab
+        sents = [" ".join(words[s:s + 12]) + "." for s in
+                 range(0, n_words, 12)]
+        yield (f"http://site{d % 97}.bench.test/doc{d}",
+               f"<html><head><title>{title}</title></head><body><p>"
+               + " ".join(sents) + "</p></body></html>")
 
 
-def _make_queries(vocab: list[str], n: int) -> list[str]:
+def _make_queries(n: int, seed: int):
+    """n UNIQUE 1-3 term zipf queries (BASELINE configs 1-2)."""
     import numpy as np
 
-    rng = np.random.default_rng(7)
-    qs = []
-    for i in range(n):
-        n_terms = int(rng.integers(1, 4))  # 1-3 term AND queries
-        terms = rng.zipf(1.3, size=n_terms) % len(vocab)
-        qs.append(" ".join(vocab[t] for t in terms))
-    return qs
-
-
-BATCH = int(os.environ.get("BENCH_BATCH", "32"))
+    rng = np.random.default_rng(seed)
+    seen: set[str] = set()
+    out: list[str] = []
+    while len(out) < n:
+        n_terms = int(rng.integers(1, 4))
+        terms = rng.zipf(1.3, size=n_terms) % VOCAB
+        q = " ".join(f"word{t}" for t in terms)
+        if q not in seen:
+            seen.add(q)
+            out.append(q)
+    return out
 
 
 def main() -> None:
+    import jax
+
+    # persistent XLA compile cache: warmup cost amortizes across runs
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.expanduser("~/.cache/osse_xla"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+    except Exception:
+        pass
+
+    from open_source_search_engine_tpu.build import docproc
     from open_source_search_engine_tpu.index.collection import Collection
     from open_source_search_engine_tpu.query import engine
 
     coll = Collection("bench", tempfile.mkdtemp(prefix="osse_bench_"))
-    _t0 = time.perf_counter()
-    vocab = _build_corpus(coll, N_DOCS)
-    build_s = time.perf_counter() - _t0
-    queries = _make_queries(vocab, N_QUERIES)
-    batches = [queries[i:i + BATCH] for i in range(0, len(queries), BATCH)]
-
-    # warmup: build the resident index + populate the jit cache
-    for b in batches:
-        engine.search_device_batch(coll, b, topk=10, with_snippets=False)
-    for q in queries[:20]:
-        engine.search_device(coll, q, topk=10, with_snippets=False)
-
-    # measured: batched resident-index throughput + single-query latency
     t0 = time.perf_counter()
-    for b in batches:
-        engine.search_device_batch(coll, b, topk=10, with_snippets=False)
-    elapsed = time.perf_counter() - t0
+    for i, (url, html) in enumerate(_gen_docs(N_DOCS)):
+        docproc.index_document(coll, url, html)
+        if (i + 1) % 20000 == 0:
+            print(f"# indexed {i + 1}/{N_DOCS} "
+                  f"({(i + 1) / (time.perf_counter() - t0):.0f} docs/s)",
+                  file=sys.stderr)
+    build_s = time.perf_counter() - t0
+    # dump → the measured path serves from the on-disk base (dense +
+    # cube rows built); the remaining delta stays empty
+    coll.posdb.dump()
+    coll.titledb.dump()
 
-    lat0 = time.perf_counter()
-    for q in queries[:20]:
+    t0 = time.perf_counter()
+    di = engine.get_device_index(coll)
+    device_build_s = time.perf_counter() - t0
+
+    warm_qs = _make_queries(4 * BATCH + N_LAT + 8, seed=99)
+    meas_qs = _make_queries(N_QUERIES, seed=7)
+    lat_qs = _make_queries(N_LAT, seed=1234)
+    # (different seeds overlap rarely; uniqueness within each set is
+    # what defeats the dispatch cache — warm queries are never measured)
+
+    t0 = time.perf_counter()
+    for i in range(0, 4 * BATCH, BATCH):  # warm batch buckets (B=32)
+        engine.search_device_batch(coll, warm_qs[i:i + BATCH], topk=10,
+                                   with_snippets=False)
+    for q in warm_qs[4 * BATCH:]:          # warm single buckets (B=4)
         engine.search_device(coll, q, topk=10, with_snippets=False)
-    lat_ms = 1000 * (time.perf_counter() - lat0) / 20
+    warm_s = time.perf_counter() - t0
 
-    qps = N_QUERIES / elapsed
+    # --- measured: batched throughput over unique queries ---
+    esc0 = di.escalations
+    t0 = time.perf_counter()
+    for i in range(0, len(meas_qs), BATCH):
+        engine.search_device_batch(coll, meas_qs[i:i + BATCH], topk=10,
+                                   with_snippets=False)
+    elapsed = time.perf_counter() - t0
+    qps = len(meas_qs) / elapsed
+
+    # --- measured: single-query latency distribution ---
+    lats = []
+    for q in lat_qs:
+        t1 = time.perf_counter()
+        engine.search_device(coll, q, topk=10, with_snippets=False)
+        lats.append(1000 * (time.perf_counter() - t1))
+    lats.sort()
+    p50 = lats[len(lats) // 2]
+
     print(json.dumps({
         "metric": "queries_per_sec",
         "value": round(qps, 2),
         "unit": "qps",
         "vs_baseline": round(qps / BASELINE_QPS, 2),
+        "p50_ms": round(p50, 1),
+        "docs": N_DOCS,
     }))
-    print(f"# corpus={N_DOCS} docs ({build_s:.1f}s build), "
-          f"{N_QUERIES} queries (batch={BATCH}) in {elapsed:.2f}s, "
-          f"single-query latency ~{lat_ms:.1f}ms", file=sys.stderr)
+    print(f"# corpus={N_DOCS} docs ({build_s:.0f}s build, "
+          f"{N_DOCS / max(build_s, 1e-9):.0f} docs/s; device build "
+          f"{device_build_s:.1f}s), warmup {warm_s:.0f}s, "
+          f"{len(meas_qs)} unique queries (batch={BATCH}) in "
+          f"{elapsed:.2f}s, p50 {p50:.1f}ms p90 "
+          f"{lats[int(len(lats) * 0.9)]:.1f}ms, "
+          f"escalations {di.escalations - esc0}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -31,6 +31,11 @@ class Process:
         self._thread: threading.Thread | None = None
         self.saves = 0
 
+    @property
+    def stopping(self) -> bool:
+        """True once shutdown (or a signal) was requested."""
+        return self._stop.is_set()
+
     def register(self, savable) -> None:
         """Register anything with a .save() (collections, spider state…)."""
         self._savables.append(savable)

@@ -93,6 +93,10 @@ class CollectionDb:
         disk = {p.name for p in (self.base_dir / "coll").glob("*") if p.is_dir()}
         return sorted(disk | set(self.colls))
 
+    def save(self) -> None:
+        """Alias so a CollectionDb can register as a Process savable."""
+        self.save_all()
+
     def save_all(self) -> None:
         for c in self.colls.values():
             c.save()

@@ -327,20 +327,26 @@ class MemTable:
 
     Reference ``RdbBuckets.h:87`` — flat sorted buckets replaced RdbTree
     for posdb because appends dominate. Same idea: O(1) appends into a
-    pending list, one vectorized sort when a read or dump needs order.
-    """
+    pending list, one vectorized sort when a read needs order.
+
+    Internally the sorted state is **size-tiered segments** (each ≥ the
+    next newer one; adjacent segments merge when the invariant breaks),
+    so interleaved add/read workloads — every document index does point
+    reads — cost amortized O(n log n) instead of the O(n²) a single
+    merged buffer costs when every read folds the pending tail in.
+    Range reads merge only the per-segment range slices."""
 
     def __init__(self, key_dtype: np.dtype, has_data: bool):
         self.key_dtype = key_dtype
         self.has_data = has_data
         self._pending_keys: list[np.ndarray] = []
         self._pending_blobs: list[bytes] = []
-        self._sorted: RecordBatch | None = None
+        self._segments: list[RecordBatch] = []  # oldest → newest
         self.nbytes = 0
 
     def __len__(self) -> int:
         n = sum(len(k) for k in self._pending_keys)
-        return n + (len(self._sorted) if self._sorted is not None else 0)
+        return n + sum(len(s) for s in self._segments)
 
     def add(self, keys: np.ndarray, blobs: list[bytes] | None = None) -> None:
         keys = np.atleast_1d(keys).astype(self.key_dtype, copy=False)
@@ -351,8 +357,11 @@ class MemTable:
         self._pending_keys.append(keys)
         self.nbytes += keys.nbytes
 
-    def batch(self) -> RecordBatch:
-        """Sorted view of everything in RAM (newest-wins within memtable)."""
+    def _seal(self) -> None:
+        """Sort the pending tail into a new segment, then restore the
+        size-tier invariant by merging newest-first (newest-wins within
+        the memtable: later segments are newer; tombstones are kept so
+        they still annihilate records in on-disk runs)."""
         if self._pending_keys:
             keys = np.concatenate(self._pending_keys)
             blobs = self._pending_blobs if self.has_data else None
@@ -360,29 +369,43 @@ class MemTable:
             # replaces a node when an equal-sans-delbit key is re-added)
             keep = _dedup_newest(keys, np.arange(len(keys), dtype=np.int64),
                                  keep_tombstones=True)
-            fresh = RecordBatch.from_records(
+            self._segments.append(RecordBatch.from_records(
                 keys[keep],
                 [blobs[int(i)] for i in keep] if blobs is not None else None,
-                presorted=True,
-            )
-            if self._sorted is not None and len(self._sorted):
-                # older sorted part first, fresh part newer; keep tombstones
-                # in RAM so they still annihilate records in on-disk runs
-                fresh = merge_batches([self._sorted, fresh],
-                                      keep_tombstones=True)
-            self._sorted = fresh
+                presorted=True))
             self._pending_keys = []
             self._pending_blobs = []
-        if self._sorted is None:
+        while (len(self._segments) >= 2
+               and len(self._segments[-2]) < 2 * len(self._segments[-1])):
+            newer = self._segments.pop()
+            older = self._segments.pop()
+            self._segments.append(merge_batches([older, newer],
+                                                keep_tombstones=True))
+
+    def range(self, start_key: np.ndarray, end_key: np.ndarray
+              ) -> RecordBatch:
+        """Merged range read over the segments (newest-wins applied)."""
+        self._seal()
+        return merge_batches(
+            [s.range(start_key, end_key) for s in self._segments],
+            keep_tombstones=True)
+
+    def batch(self) -> RecordBatch:
+        """Sorted view of everything in RAM (newest-wins within memtable)."""
+        self._seal()
+        if len(self._segments) > 1:
+            self._segments = [merge_batches(self._segments,
+                                            keep_tombstones=True)]
+        if not self._segments:
             empty = np.empty(0, dtype=self.key_dtype)
-            self._sorted = RecordBatch.from_records(
-                empty, [] if self.has_data else None)
-        return self._sorted
+            self._segments = [RecordBatch.from_records(
+                empty, [] if self.has_data else None)]
+        return self._segments[0]
 
     def clear(self) -> None:
         self._pending_keys = []
         self._pending_blobs = []
-        self._sorted = None
+        self._segments = []
         self.nbytes = 0
 
 
@@ -480,7 +503,7 @@ class Rdb:
     def get_list(self, start_key: np.ndarray, end_key: np.ndarray) -> RecordBatch:
         """Merged range read across runs + memtable, tombstones applied."""
         sources = [r.batch().range(start_key, end_key) for r in self.runs]
-        sources.append(self.mem.batch().range(start_key, end_key))
+        sources.append(self.mem.range(start_key, end_key))
         return merge_batches(sources)
 
     def get_all(self) -> RecordBatch:

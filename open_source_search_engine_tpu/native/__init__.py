@@ -63,10 +63,6 @@ def get_lib():
         lib.osse_searchsorted.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
             ctypes.c_void_p, ctypes.c_int32]
-        lib.osse_dedup_sorted.restype = ctypes.c_int64
-        lib.osse_dedup_sorted.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
-            ctypes.c_int32, ctypes.POINTER(ctypes.c_int64)]
         _lib = lib
         log.info("librdbcore loaded")
         return _lib

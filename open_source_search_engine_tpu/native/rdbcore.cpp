@@ -101,25 +101,4 @@ int64_t osse_searchsorted(const uint8_t* run, int64_t n, int32_t key_size,
   return lo;
 }
 
-// within-run newest-wins dedup + annihilation for an UNSORTED batch:
-// sorts indices by (identity, recency) then keeps the newest of each
-// group — the MemTable batch() hot path. idx_out gets surviving record
-// indices in key order; returns the count.
-int64_t osse_dedup_sorted(const uint8_t* keys, int64_t n, int32_t key_size,
-                          int32_t keep_tombstones, int64_t* idx_out) {
-  // keys must already be sorted by identity (stable, oldest first within
-  // equal identity). Single pass: last of each identity group wins.
-  int64_t written = 0;
-  int64_t i = 0;
-  while (i < n) {
-    int64_t j = i + 1;
-    const uint8_t* ki = keys + i * key_size;
-    while (j < n && cmp_ident(keys + j * key_size, ki, key_size) == 0) ++j;
-    const uint8_t* win = keys + (j - 1) * key_size;
-    if ((win[0] & 1u) || keep_tombstones) idx_out[written++] = j - 1;
-    i = j;
-  }
-  return written;
-}
-
 }  // extern "C"

@@ -5,10 +5,14 @@ The TPU-native replacement for the reference's cluster layer (SURVEY
 record routing → :class:`sharded.ShardedCollection` adds; Msg3a/Msg39
 scatter-gather with per-shard intersect + cross-shard top-k merge →
 ``shard_map`` over a ``jax.sharding.Mesh`` with an in-mesh all-gather
-merge (ICI collectives instead of reliable-UDP fan-out).
+merge (ICI collectives instead of reliable-UDP fan-out); the
+UdpServer/Multicast/PingServer host plane (shards as separate node
+processes, twin failover, retry-forever writes) → :mod:`cluster`.
 """
 
+from .cluster import ClusterClient, HostsConf, ShardNodeServer
 from .hostmap import HostMap, make_mesh
 from .sharded import ShardedCollection, sharded_search
 
-__all__ = ["HostMap", "make_mesh", "ShardedCollection", "sharded_search"]
+__all__ = ["ClusterClient", "HostMap", "HostsConf", "ShardNodeServer",
+           "ShardedCollection", "make_mesh", "sharded_search"]

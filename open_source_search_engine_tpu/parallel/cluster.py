@@ -1,0 +1,512 @@
+"""Multi-process host plane — shards as separate node processes.
+
+This is the reference's L2 made real across process boundaries: a node
+process owns one shard replica (a :class:`~..index.collection.Collection`
+plus its device index) and serves a small RPC surface; a client-side
+:class:`ClusterClient` routes work by the same key→shard maps the
+in-process plane uses. Reference semantics carried over:
+
+* **Topology from a hosts.conf-style file** (``Hostdb.cpp:124``):
+  ``num-mirrors: M`` then one ``host:port`` line per node; the first
+  ``n_shards`` lines are replica 0, the next ``n_shards`` replica 1, …
+* **Writes go to ALL twins, retry-forever to dead ones**
+  (``Msg1.cpp:20``): a failed delivery parks in a per-host retry queue
+  that redelivers in the background until the twin answers — a
+  restarted node catches up from the queue (plus its own durable Rdb
+  state) without any resync ceremony.
+* **Reads pick the serving twin and reroute on failure**
+  (``Multicast.cpp:520`` ``pickBestHost``): a connection error or
+  timeout marks the host dead and retries the next twin immediately;
+  when every twin of a shard is down the query still answers, flagged
+  ``degraded=True`` (the silent-partial-results trap from round 2).
+* **Heartbeats** (``PingServer.h:61``): a background prober pings every
+  node and maintains the alive matrix; recovered hosts are marked
+  alive again and immediately serve.
+
+The transport is deliberately boring (JSON over loopback/LAN HTTP —
+stdlib only): the *semantics* are the work, and the reference itself
+treats its UDP layer as a replaceable courier. Scatter-gather queries
+(the Msg3a merge) run the per-shard execution in parallel threads and
+merge top-k host-side; inside each node the query still runs on the
+TPU-resident two-phase kernel, so ICI does the per-shard heavy lifting
+and this plane is the DCN/control story.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import numpy as np
+
+from ..index.collection import Collection
+from ..utils import ghash
+from ..utils.log import get_logger
+from .hostmap import HostMap
+
+log = get_logger("cluster")
+
+RPC_TIMEOUT_S = 10.0
+PING_TIMEOUT_S = 1.5
+RETRY_INTERVAL_S = 1.0
+HEARTBEAT_INTERVAL_S = 1.0
+
+
+# ---------------------------------------------------------------------------
+# topology file (hosts.conf, Hostdb.cpp:124)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class HostsConf:
+    """Parsed hosts.conf: addresses[shard][replica] = "host:port"."""
+
+    n_shards: int
+    n_replicas: int
+    addresses: list[list[str]]  # [shard][replica]
+
+    @classmethod
+    def parse(cls, text: str) -> "HostsConf":
+        mirrors = 0
+        hosts: list[str] = []
+        for line in text.splitlines():
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            if line.startswith("num-mirrors:"):
+                mirrors = int(line.split(":", 1)[1])
+            else:
+                hosts.append(line)
+        n_replicas = mirrors + 1
+        if not hosts or len(hosts) % n_replicas:
+            raise ValueError(
+                f"hosts.conf: {len(hosts)} hosts not divisible by "
+                f"{n_replicas} replicas")
+        n_shards = len(hosts) // n_replicas
+        addresses = [[hosts[r * n_shards + s] for r in range(n_replicas)]
+                     for s in range(n_shards)]
+        return cls(n_shards, n_replicas, addresses)
+
+    @classmethod
+    def load(cls, path: str | Path) -> "HostsConf":
+        return cls.parse(Path(path).read_text())
+
+    def dump(self) -> str:
+        lines = [f"num-mirrors: {self.n_replicas - 1}"]
+        for r in range(self.n_replicas):
+            lines += [self.addresses[s][r] for s in range(self.n_shards)]
+        return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# node side (the per-shard RPC server)
+# ---------------------------------------------------------------------------
+
+class ShardNodeServer:
+    """One shard replica as a process: Collection + RPC surface.
+
+    Endpoints (the live msgType registry, SURVEY §2.4, as paths):
+    ``/rpc/index`` (Msg7/Msg4 add), ``/rpc/remove``, ``/rpc/search``
+    (Msg39 per-shard exec), ``/rpc/doc`` (Msg22 titlerec), ``/rpc/ping``
+    (PingServer), ``/rpc/save`` (gb save broadcast).
+    """
+
+    def __init__(self, data_dir: str | Path, host: str = "127.0.0.1",
+                 port: int = 0, use_device: bool = False):
+        self.coll = Collection("shard", data_dir)
+        self.host = host
+        self.port = port
+        self.use_device = use_device
+        self._httpd: ThreadingHTTPServer | None = None
+        self._lock = threading.RLock()  # single-writer core
+        # crash journal (Msg4.cpp:115 addsinprogress.dat): adds are
+        # journaled BEFORE they are acked, replayed on restart, and the
+        # journal truncates whenever the memtable state is saved — so a
+        # SIGKILL'd node recovers every acked write
+        self._journal_path = Path(data_dir) / "addsinprogress.jsonl"
+        self._replay_journal()
+        self._journal = open(self._journal_path, "a",  # noqa: SIM115
+                             encoding="utf-8")
+        self._writes_since_save = 0
+
+    def _replay_journal(self) -> None:
+        from ..build import docproc
+
+        if not self._journal_path.exists():
+            return
+        n = 0
+        for line in self._journal_path.read_text(
+                encoding="utf-8").splitlines():
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+                if rec.get("op") == "remove":
+                    docproc.remove_document(self.coll, rec["url"])
+                else:
+                    docproc.index_document(self.coll, rec["url"],
+                                           rec["content"])
+                n += 1
+            except Exception as e:  # noqa: BLE001 — torn tail line etc.
+                log.warning("journal replay skipped a record: %s", e)
+        if n:
+            log.info("replayed %d journaled adds", n)
+
+    def _journal_write(self, rec: dict) -> None:
+        self._journal.write(json.dumps(rec) + "\n")
+        self._journal.flush()
+        os.fsync(self._journal.fileno())
+
+    # --- request handlers -------------------------------------------------
+
+    def handle(self, path: str, payload: dict) -> dict:
+        from ..build import docproc
+        from ..query import engine
+
+        with self._lock:
+            if path == "/rpc/ping":
+                return {"ok": True, "docs": self.coll.num_docs}
+            if path == "/rpc/index":
+                self._journal_write({"url": payload["url"],
+                                     "content": payload["content"]})
+                ml = docproc.index_document(
+                    self.coll, payload["url"], payload["content"])
+                self._maybe_checkpoint()
+                return {"ok": True, "docid": int(ml.docid)}
+            if path == "/rpc/remove":
+                self._journal_write({"op": "remove",
+                                     "url": payload["url"]})
+                ok = docproc.remove_document(self.coll, payload["url"])
+                return {"ok": bool(ok)}
+            if path == "/rpc/search":
+                search = (engine.search_device if self.use_device
+                          else engine.search)
+                res = search(self.coll, payload["q"],
+                             topk=int(payload.get("topk", 10)),
+                             lang=int(payload.get("lang", 0)),
+                             with_snippets=False, site_cluster=False)
+                return {
+                    "ok": True,
+                    "total": res.total_matches,
+                    "docids": [int(r.docid) for r in res.results],
+                    "scores": [float(r.score) for r in res.results],
+                }
+            if path == "/rpc/doc":
+                from ..build.docproc import get_document
+                rec = get_document(self.coll,
+                                   docid=int(payload["docid"]))
+                return {"ok": rec is not None, "doc": rec}
+            if path == "/rpc/save":
+                self.save()
+                return {"ok": True}
+        raise KeyError(path)
+
+    def save(self) -> None:
+        """Checkpoint under the writer lock; the saved state supersedes
+        the journal (Msg4 truncates addsinprogress once trees save)."""
+        with self._lock:
+            self.coll.save()
+            self._journal.seek(0)
+            self._journal.truncate()
+            self._writes_since_save = 0
+
+    def _maybe_checkpoint(self) -> None:
+        """Bound journal growth/replay cost: checkpoint every few
+        hundred acked writes (caller holds the writer lock)."""
+        self._writes_since_save += 1
+        if self._writes_since_save >= 512:
+            self.coll.save()
+            self._journal.seek(0)
+            self._journal.truncate()
+            self._writes_since_save = 0
+
+    # --- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                log.debug("%s " + fmt, self.client_address[0], *args)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b"{}"
+                try:
+                    payload = json.loads(body or b"{}")
+                    out = outer.handle(self.path, payload)
+                    code = 200
+                except KeyError:
+                    out, code = {"error": "no such rpc"}, 404
+                except Exception as e:  # noqa: BLE001 — node must not die
+                    out, code = {"error": str(e)}, 500
+                data = json.dumps(out).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            do_GET = do_POST
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        log.info("shard node on %s:%d (%d docs)", self.host, self.port,
+                 self.coll.num_docs)
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+# ---------------------------------------------------------------------------
+# client side (Msg1 writes / Msg0+Multicast reads / Msg3a merge)
+# ---------------------------------------------------------------------------
+
+def _rpc(addr: str, path: str, payload: dict,
+         timeout: float = RPC_TIMEOUT_S) -> dict:
+    req = urllib.request.Request(
+        f"http://{addr}{path}", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.load(r)
+
+
+@dataclass
+class _Pending:
+    """One undelivered write (the Msg1 retry-forever unit)."""
+
+    shard: int
+    replica: int
+    path: str
+    payload: dict
+    attempts: int = 0
+
+
+class _HostQueue:
+    """Per-host ORDERED redelivery queue.
+
+    Ordering is the point: once a host has parked writes, every later
+    write to that host must line up behind them — delivering a new
+    write around an old one would make the stale version the newest
+    memtable insertion on the twin (newest-wins would then resurrect
+    it). Drains stop at the first failure so order is preserved."""
+
+    def __init__(self):
+        self.items: list[_Pending] = []
+        self.lock = threading.Lock()
+        self.in_flight = False
+
+    def __len__(self) -> int:
+        with self.lock:
+            return len(self.items)
+
+
+class ClusterClient:
+    """Routes adds/reads/queries across the node processes."""
+
+    def __init__(self, conf: HostsConf, use_heartbeat: bool = True):
+        self.conf = conf
+        self.hostmap = HostMap(conf.n_shards, conf.n_replicas)
+        self._queues = {(s, r): _HostQueue()
+                        for s in range(conf.n_shards)
+                        for r in range(conf.n_replicas)}
+        self._stop = threading.Event()
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(4, 2 * conf.n_shards * conf.n_replicas))
+        self._retry_thread = threading.Thread(
+            target=self._retry_loop, daemon=True, name="msg1-retry")
+        self._retry_thread.start()
+        self._hb_thread = None
+        if use_heartbeat:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, daemon=True,
+                name="pingserver")
+            self._hb_thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._pool.shutdown(wait=False)
+
+    @property
+    def pending_writes(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    # --- liveness (PingServer) -------------------------------------------
+
+    def _ping(self, shard: int, replica: int) -> bool:
+        try:
+            out = _rpc(self.conf.addresses[shard][replica], "/rpc/ping",
+                       {}, timeout=PING_TIMEOUT_S)
+            return bool(out.get("ok"))
+        except Exception:  # noqa: BLE001
+            return False
+
+    def check_hosts(self) -> None:
+        """One heartbeat sweep over every host."""
+        for s in range(self.conf.n_shards):
+            for r in range(self.conf.n_replicas):
+                if self._ping(s, r):
+                    self.hostmap.mark_alive(s, r)
+                else:
+                    self.hostmap.mark_dead(s, r)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(HEARTBEAT_INTERVAL_S):
+            self.check_hosts()
+
+    # --- writes (Msg1: all twins, retry forever) -------------------------
+
+    def _deliver(self, p: _Pending) -> bool:
+        try:
+            out = _rpc(self.conf.addresses[p.shard][p.replica], p.path,
+                       p.payload)
+            return bool(out.get("ok"))
+        except Exception as e:  # noqa: BLE001
+            log.debug("deliver to %d/%d failed: %s", p.shard, p.replica, e)
+            return False
+
+    def _drain_host(self, key: tuple[int, int]) -> None:
+        """Redeliver one host's parked writes IN ORDER, stopping at the
+        first failure (retry forever, Msg1.cpp:20)."""
+        q = self._queues[key]
+        try:
+            while not self._stop.is_set():
+                with q.lock:
+                    if not q.items:
+                        return
+                    p = q.items[0]
+                if self._deliver(p):
+                    self.hostmap.mark_alive(p.shard, p.replica)
+                    with q.lock:
+                        q.items.pop(0)
+                else:
+                    p.attempts += 1
+                    self.hostmap.mark_dead(p.shard, p.replica)
+                    return  # next sweep retries; order preserved
+        finally:
+            with q.lock:
+                q.in_flight = False
+
+    def _retry_loop(self) -> None:
+        """Sweep: kick an independent drain per backlogged host — a
+        hung host never head-of-line-blocks a healthy one."""
+        while not self._stop.wait(RETRY_INTERVAL_S):
+            for key, q in self._queues.items():
+                with q.lock:
+                    if not q.items or q.in_flight:
+                        continue
+                    q.in_flight = True
+                self._pool.submit(self._drain_host, key)
+
+    def _send_one(self, shard: int, r: int, p: _Pending) -> None:
+        q = self._queues[(shard, r)]
+        with q.lock:
+            backlog = bool(q.items)
+            if backlog:
+                # ordering: never overtake parked writes to this host
+                q.items.append(p)
+        if backlog:
+            return
+        if not self._deliver(p):
+            self.hostmap.mark_dead(shard, r)
+            with q.lock:
+                q.items.append(p)
+
+    def _write_all_twins(self, shard: int, path: str, payload: dict
+                         ) -> None:
+        # twins deliver concurrently: a hung twin costs its own timeout,
+        # not every caller's write latency × replicas
+        futs = [self._pool.submit(self._send_one, shard, r,
+                                  _Pending(shard, r, path, payload))
+                for r in range(self.conf.n_replicas)]
+        for f in futs:
+            f.result()
+
+    def index_document(self, url: str, content: str) -> int:
+        docid = ghash.doc_id(url)
+        shard = int(self.hostmap.shard_of_docid(docid))
+        self._write_all_twins(shard, "/rpc/index",
+                              {"url": url, "content": content})
+        return docid
+
+    def remove_document(self, url: str) -> None:
+        docid = ghash.doc_id(url)
+        shard = int(self.hostmap.shard_of_docid(docid))
+        self._write_all_twins(shard, "/rpc/remove", {"url": url})
+
+    def save_all(self) -> None:
+        for s in range(self.conf.n_shards):
+            self._write_all_twins(s, "/rpc/save", {})
+
+    # --- reads (Multicast serving-twin pick + reroute) -------------------
+
+    def _read_shard(self, shard: int, path: str, payload: dict
+                    ) -> dict | None:
+        """Try twins in liveness order; mark failures dead and reroute
+        (Multicast.cpp:520). None = whole shard down."""
+        order = sorted(range(self.conf.n_replicas),
+                       key=lambda r: not self.hostmap.alive[shard, r])
+        for r in order:
+            try:
+                out = _rpc(self.conf.addresses[shard][r], path, payload)
+                if out.get("ok") or "total" in out:
+                    self.hostmap.mark_alive(shard, r)
+                    return out
+            except Exception:  # noqa: BLE001
+                self.hostmap.mark_dead(shard, r)
+        return None
+
+    def get_document(self, docid: int) -> dict | None:
+        shard = int(self.hostmap.shard_of_docid(docid))
+        out = self._read_shard(shard, "/rpc/doc", {"docid": int(docid)})
+        return out.get("doc") if out else None
+
+    # --- scatter-gather query (Msg3a) ------------------------------------
+
+    def search(self, q: str, topk: int = 10, lang: int = 0,
+               with_snippets: bool = True, site_cluster: bool = True):
+        """Fan out to every shard's serving twin, merge top-k, then
+        fetch titlerecs from the owning shards (Msg20)."""
+        from ..query.compiler import compile_query
+        from ..query.engine import SearchResults, build_results
+
+        over = max(topk * 2, 16)
+        futs = [self._pool.submit(
+            self._read_shard, s, "/rpc/search",
+            {"q": q, "topk": over, "lang": lang})
+            for s in range(self.conf.n_shards)]
+        total = 0
+        docids: list[int] = []
+        scores: list[float] = []
+        degraded = False
+        for f in futs:
+            out = f.result()
+            if out is None:
+                degraded = True  # whole shard down: partial answer
+                continue
+            total += int(out.get("total", 0))
+            docids += out.get("docids", [])
+            scores += out.get("scores", [])
+        order = np.argsort(-np.asarray(scores, dtype=np.float64),
+                           kind="stable")
+        plan = compile_query(q, lang=lang)
+        results, clustered = build_results(
+            self.get_document,
+            [docids[i] for i in order], [scores[i] for i in order],
+            plan, topk=topk, with_snippets=with_snippets,
+            site_cluster=site_cluster)
+        return SearchResults(
+            query=q, total_matches=total, results=results,
+            clustered=clustered, degraded=degraded)

@@ -35,6 +35,18 @@ becomes two dense phases:
   (rare: bounds are tight). This makes the pruning *lossless* — the
   TPU analog of TopTree's floor check, and of the reference's recall
   re-loop (``Msg40.cpp:2117``).
+* **Full-cube path (F2).** Queries whose every required group is a
+  high-df term defeat bound pruning — the intersection is most of the
+  corpus and pair bounds can't rank it (the pair score's distance term
+  is unknowable without positions). The reference grinds these with
+  its per-docid loop; here they route to a second kernel that scores
+  the WHOLE doc axis exactly: the heaviest terms' position cubes are
+  **materialized at build time** as [P, D] rows (plain slices at query
+  time — zero gather), smaller sublists (bigrams, deltas) scatter
+  their postings in at posting granularity, and the same
+  scorer.min_scores runs over [T, P, D]. Dense full-lane compute is
+  exactly what the VPU is good at — no pruning needed, no escalation
+  ladder, still bit-parity with the host path.
 
 Why this shape: on v5e, scalar gather runs ~60 Melem/s and scatter ~10
 Melem/s, while dense row ops and 128-lane block gathers run 10-100×
@@ -93,9 +105,10 @@ from .scorer import final_multipliers, min_scores
 log = get_logger("devindex")
 
 #: shape-bucket floors (distinct shape tuples = one XLA compile each)
-RD_FLOOR = 4      # dense rows
-RS_FLOOR = 4      # sparse rows
-LSP_FLOOR = 512   # sparse gather lanes
+RD_FLOOR = 8      # dense rows
+RS_FLOOR = 8      # sparse rows
+LSP_FLOOR = 2048  # sparse gather lanes — single bucket when the dense
+                  # threshold (D_cap//64) keeps every sparse run under it
 B_FLOOR = 4
 KAPPA_FLOOR = 256  # phase-2 candidate count
 DOC_UPD_FLOOR = 64
@@ -104,7 +117,25 @@ DOC_UPD_FLOOR = 64
 DOC_QUANTUM = 2048
 
 #: HBM budget for dense [V, D_cap] impact+runstart rows (8 bytes/doc/term)
-DENSE_BUDGET_BYTES = 128 << 20
+DENSE_BUDGET_BYTES = 256 << 20
+
+#: minimum df for a term to earn a dense impact row
+DENSE_MIN_DF = 1024
+
+#: HBM budget for materialized [P, D_cap] cube rows (P·4 bytes/doc/term)
+CUBE_BUDGET_BYTES = 768 << 20
+
+#: routing: drivers at or below this df use phase-1 pruning (F1);
+#: bigger drivers go to the full-cube kernel (F2) when eligible
+CUBE_MIN_DF = 8192
+
+#: F2 eligibility: non-cube sublists must scatter at most this many
+#: postings (the per-row scatter lane bucket cap)
+F2_SCATTER_MAX = 16384
+F2_LPOST_FLOOR = 4096
+F2_B_FLOOR = 4
+RC_FLOOR = 8
+RP_FLOOR = 8
 
 #: posting/doc column padding quantum
 COL_QUANTUM = 1 << 15
@@ -205,6 +236,14 @@ def _write_tail(buf, tail, offset):
     return jax.lax.dynamic_update_slice(buf, tail, (offset,))
 
 
+@partial(jax.jit, static_argnames=("total",))
+def _build_cube_rows(payload, src, dst, total: int):
+    """Materialize the cube rows device-side: one scatter of the cube
+    terms' postings (pad lanes carry dst == total → dropped)."""
+    return jnp.zeros((total,), jnp.uint32).at[dst].set(
+        payload[jnp.clip(src, 0, payload.shape[0] - 1)], mode="drop")
+
+
 class _DeltaOverflow(Exception):
     def __init__(self, needed_docs: int = 0, needed_cols: int = 0):
         self.needed_docs = needed_docs
@@ -229,6 +268,20 @@ class ResidentPlan:
     s_quota: np.ndarray      # int32 [Rs]
     s_syn: np.ndarray        # uint32 [Rs]
     s_isbase: np.ndarray     # bool [Rs] (base postings dead-mask)
+    # full-cube (F2) rows: materialized cube slices + posting scatters
+    c_slot: np.ndarray       # int32 [Rc] cube matrix row (-1 = pad)
+    c_dslot: np.ndarray      # int32 [Rc] dense row (count source)
+    c_group: np.ndarray      # int32 [Rc]
+    c_base: np.ndarray       # int32 [Rc]
+    c_quota: np.ndarray      # int32 [Rc]
+    c_syn: np.ndarray        # uint32 [Rc]
+    p_start: np.ndarray      # int32 [Rp] absolute posting offset
+    p_len: np.ndarray        # int32 [Rp]
+    p_group: np.ndarray      # int32 [Rp]
+    p_base: np.ndarray       # int32 [Rp]
+    p_quota: np.ndarray      # int32 [Rp]
+    p_syn: np.ndarray        # uint32 [Rp]
+    p_isbase: np.ndarray     # bool [Rp]
     # per-group query state
     freq_weight: np.ndarray  # float32 [T]
     required: np.ndarray     # bool [T]
@@ -236,7 +289,8 @@ class ResidentPlan:
     scored: np.ndarray       # bool [T]
     qlang: int
     matchable: bool
-    driver_df: int = 0       # min required-group df (escalation bound)
+    driver_df: int = 0       # min required-group df (routes F1 vs F2)
+    f2_eligible: bool = False  # every non-cube run scatters ≤ F2 cap
 
 
 class DeviceIndex:
@@ -297,6 +351,7 @@ class DeviceIndex:
             # store-cap: scoring consumes ≤ P positions per (term, doc),
             # so postings past occurrence P are dead weight in HBM
             keep = occ < P
+            pocc = occ[keep].astype(np.uint8)
             f = {k: v[keep] for k, v in f.items()}
             termids, docids = f["termid"], f["docid"]
             if len(termids) >= _MAX_POSTINGS:
@@ -325,14 +380,17 @@ class DeviceIndex:
             self.dir_dstart = np.r_[
                 np.searchsorted(runstart, tstarts), len(runstart)
             ].astype(np.int64)
+            self.dir_pstart = np.r_[tstarts, n].astype(np.int64)
             siterank = f["siterank"].astype(np.int32)
             langid = f["langid"].astype(np.int32)
         else:
             self.dir_termids = np.empty(0, np.uint64)
             self.base_df = np.empty(0, np.int64)
             self.dir_dstart = np.zeros(1, np.int64)
+            self.dir_pstart = np.zeros(1, np.int64)
             self.base_docids = np.empty(0, np.uint64)
             docidx = np.empty(0, np.int32)
+            pocc = np.empty(0, np.uint8)
             payload = np.empty(0, np.uint32)
             doc_col = np.empty(0, np.int32)
             imp_col = np.empty(0, np.float32)
@@ -356,7 +414,7 @@ class DeviceIndex:
         # --- dense rows: highest-df terms get a dense [D_cap] impact +
         # runstart row (phase 1 adds them with zero gather/scatter) ---
         dfs = np.diff(self.dir_dstart)
-        tau = max(1024, self.D_cap // 16)
+        tau = max(DENSE_MIN_DF, self.D_cap // 64)
         slots_budget = max(DENSE_BUDGET_BYTES // (8 * self.D_cap), 1)
         eligible = np.nonzero(dfs > tau)[0]
         eligible = eligible[np.argsort(-dfs[eligible], kind="stable")]
@@ -371,6 +429,25 @@ class DeviceIndex:
             dense_rsp[slot, doc_col[a:b]] = rsp_col[a:b]
             self.dense_slot_of[int(self.dir_termids[ti])] = slot
 
+        # --- cube rows: the very heaviest terms' [P, D] position cubes,
+        # materialized so the full-cube kernel (F2) reads them as plain
+        # slices. Built device-side by one scatter from the posting
+        # columns — no multi-hundred-MB host upload ---
+        cube_budget = max(CUBE_BUDGET_BYTES // (P * self.D_cap * 4), 1)
+        cube_terms = dense_terms[:cube_budget]
+        Vc = _bucket(max(len(cube_terms), 1), 4)
+        self.cube_slot_of: dict[int, int] = {}
+        cube_src: list[np.ndarray] = []
+        cube_dst: list[np.ndarray] = []
+        for slot, ti in enumerate(cube_terms):
+            a, b = int(self.dir_pstart[ti]), int(self.dir_pstart[ti + 1])
+            src = np.arange(a, b, dtype=np.int64)
+            dst = ((slot * P + pocc[a:b].astype(np.int64)) * self.D_cap
+                   + docidx[a:b])
+            cube_src.append(src)
+            cube_dst.append(dst)
+            self.cube_slot_of[int(self.dir_termids[ti])] = slot
+
         # --- device columns: base + preallocated delta tail ---
         self.h_doc_col = doc_col
         self.Nb = _bucket(max(n, 1), COL_QUANTUM)
@@ -381,6 +458,8 @@ class DeviceIndex:
         self.M2 = self.N2
         self.d_payload = jax.device_put(
             _pad_col(payload, self.Nb + self.N2))
+        self.d_pdoc = jax.device_put(_pad_col(docidx, self.Nb + self.N2))
+        self.d_pocc = jax.device_put(_pad_col(pocc, self.Nb + self.N2))
         self.d_doc = jax.device_put(_pad_col(doc_col, self.Mb + self.M2))
         self.d_imp = jax.device_put(_pad_col(imp_col, self.Mb + self.M2))
         self.d_rsp = jax.device_put(_pad_col(rsp_col, self.Mb + self.M2))
@@ -389,11 +468,26 @@ class DeviceIndex:
         self.d_siterank = jax.device_put(sr)
         self.d_doclang = jax.device_put(dl)
         self.d_dead = jax.device_put(np.zeros(self.D_cap, bool))
+        self.Vc = Vc
+        total = Vc * P * self.D_cap
+        if cube_src:
+            csrc = np.concatenate(cube_src)
+            cdst = np.concatenate(cube_dst)
+            ncube = _bucket(len(csrc), COL_QUANTUM)
+            dstp = np.full(ncube, total, np.int64)  # pad → dropped
+            dstp[: len(cdst)] = cdst
+            self.d_cube = _build_cube_rows(
+                self.d_payload,
+                jax.device_put(_pad_col(csrc.astype(np.int32), ncube)),
+                jax.device_put(dstp), total=total)
+        else:
+            self.d_cube = jnp.zeros((total,), jnp.uint32)
         self._base_fp = fp
         self.full_rebuilds += 1
         log.info("device base built: %d postings, %d docs, %d terms "
-                 "(%d dense rows, cap %d)", n, Db, len(self.dir_termids),
-                 len(dense_terms), self.D_cap)
+                 "(%d dense rows, %d cube rows, cap %d)", n, Db,
+                 len(self.dir_termids), len(dense_terms),
+                 len(cube_terms), self.D_cap)
 
     def _build_delta(self) -> None:
         """Delta columns from the memtable — O(memtable) per refresh.
@@ -472,6 +566,7 @@ class DeviceIndex:
             self.dir2_termids, _, self.delta_df = _term_dfs(
                 fp_["termid"], occ == 0)
             keep = occ < self.P
+            pocc2 = occ[keep].astype(np.uint8)
             fp_ = {k: v[keep] for k, v in fp_.items()}
             docidx = docidx[keep]
             n2 = len(docidx)
@@ -498,6 +593,7 @@ class DeviceIndex:
             self.dir2_dstart = np.r_[
                 np.searchsorted(runstart2, tstarts), len(runstart2)
             ].astype(np.int64)
+            self.dir2_pstart = np.r_[tstarts, n2].astype(np.int64)
             self.all_docids = np.concatenate([self.base_docids, new_docids])
             payload2 = pack_payload(fp_)
             # doc-table updates from first delta posting per doc
@@ -509,6 +605,12 @@ class DeviceIndex:
             self.d_payload = _write_tail(
                 self.d_payload,
                 jax.device_put(_pad_col(payload2, self.N2)),
+                np.int32(self.Nb))
+            self.d_pdoc = _write_tail(
+                self.d_pdoc, jax.device_put(_pad_col(docidx, self.N2)),
+                np.int32(self.Nb))
+            self.d_pocc = _write_tail(
+                self.d_pocc, jax.device_put(_pad_col(pocc2, self.N2)),
                 np.int32(self.Nb))
             self.d_doc = _write_tail(
                 self.d_doc, jax.device_put(_pad_col(doc2_col, self.M2)),
@@ -540,6 +642,7 @@ class DeviceIndex:
     def _set_empty_delta(self) -> None:
         self.dir2_termids = np.empty(0, np.uint64)
         self.dir2_dstart = np.zeros(1, np.int64)
+        self.dir2_pstart = np.zeros(1, np.int64)
         self.delta_df = np.empty(0, np.int64)
         self.all_docids = self.base_docids
         # delta tails keep whatever stale content they hold — nothing
@@ -552,21 +655,28 @@ class DeviceIndex:
     # --- planning --------------------------------------------------------
 
     def _druns_of(self, termid: int):
-        """[(is_base, dstart, dlen, dense_slot)] doc-column runs for a
-        termid (dense_slot ≥ 0 when the base run is a dense row)."""
+        """[(is_base, dstart, dlen, dense_slot, cube_slot, pstart, plen)]
+        runs for a termid: doc-column run + posting-column run, with the
+        dense/cube row slots (-1 when absent)."""
         out = []
         i = int(np.searchsorted(self.dir_termids, np.uint64(termid)))
         if i < len(self.dir_termids) and self.dir_termids[i] == termid:
             a, b = int(self.dir_dstart[i]), int(self.dir_dstart[i + 1])
             if b > a:
+                pa, pb = int(self.dir_pstart[i]), int(self.dir_pstart[i + 1])
                 out.append((True, a, b - a,
-                            self.dense_slot_of.get(termid, -1)))
+                            self.dense_slot_of.get(termid, -1),
+                            self.cube_slot_of.get(termid, -1),
+                            pa, pb - pa))
         j = int(np.searchsorted(self.dir2_termids, np.uint64(termid)))
         if j < len(self.dir2_termids) and self.dir2_termids[j] == termid:
             a, b = int(self.dir2_dstart[j]), int(self.dir2_dstart[j + 1])
             if b > a:
-                # delta doc columns live at [Mb, Mb + n2)
-                out.append((False, self.Mb + a, b - a, -1))
+                # delta doc/posting columns live past Mb / Nb
+                pa, pb = int(self.dir2_pstart[j]), \
+                    int(self.dir2_pstart[j + 1])
+                out.append((False, self.Mb + a, b - a, -1, -1,
+                            self.Nb + pa, pb - pa))
         return out
 
     def _df_of(self, termid: int) -> int:
@@ -583,9 +693,10 @@ class DeviceIndex:
 
     def plan(self, qplan: QueryPlan) -> ResidentPlan:
         T = _bucket(max(len(qplan.groups), 1), T_FLOOR)
-        drows, srows = [], []
+        drows, srows, crows, prows = [], [], [], []
         dfs = np.zeros(max(len(qplan.groups), 1), np.int64)
         matchable = True
+        f2_ok = True
         any_required = False
         driver_df = 1 << 60
         for g_i, g in enumerate(qplan.groups):
@@ -595,12 +706,25 @@ class DeviceIndex:
             gdf = 0
             for s_i, sub in enumerate(subs):
                 syn = 1 if sub.kind == SUB_SYNONYM else 0
-                for is_base, a, ln, slot in self._druns_of(sub.termid):
-                    if slot >= 0:
-                        drows.append((slot, g_i, s_i * quota, quota, syn))
+                base = s_i * quota
+                for is_base, a, ln, dslot, cslot, pa, pl in \
+                        self._druns_of(sub.termid):
+                    # F1 row split: dense [D] impact row vs sparse run
+                    if dslot >= 0:
+                        drows.append((dslot, g_i, base, quota, syn))
                     else:
-                        srows.append((a, ln, g_i, s_i * quota, quota, syn,
+                        srows.append((a, ln, g_i, base, quota, syn,
                                       is_base))
+                    # F2 row split: materialized cube slice vs posting
+                    # scatter (bounded lanes)
+                    if cslot >= 0:
+                        crows.append((cslot, dslot, g_i, base, quota,
+                                      syn))
+                    elif pl <= F2_SCATTER_MAX:
+                        prows.append((pa, pl, g_i, base, quota, syn,
+                                      is_base))
+                    else:
+                        f2_ok = False
                     any_postings = True
                 gdf = max(gdf, self._df_of(sub.termid))
             dfs[g_i] = gdf
@@ -618,6 +742,8 @@ class DeviceIndex:
                                      max(self.coll.num_docs, 1)), T, 0.5)
         da = np.array(drows, np.int64).reshape(-1, 5)
         sa = np.array(srows, np.int64).reshape(-1, 7)
+        ca = np.array(crows, np.int64).reshape(-1, 6)
+        pa_ = np.array(prows, np.int64).reshape(-1, 7)
         return ResidentPlan(
             d_slot=da[:, 0].astype(np.int32),
             d_group=da[:, 1].astype(np.int32),
@@ -631,9 +757,23 @@ class DeviceIndex:
             s_quota=sa[:, 4].astype(np.int32),
             s_syn=sa[:, 5].astype(np.uint32),
             s_isbase=sa[:, 6].astype(bool),
+            c_slot=ca[:, 0].astype(np.int32),
+            c_dslot=ca[:, 1].astype(np.int32),
+            c_group=ca[:, 2].astype(np.int32),
+            c_base=ca[:, 3].astype(np.int32),
+            c_quota=ca[:, 4].astype(np.int32),
+            c_syn=ca[:, 5].astype(np.uint32),
+            p_start=pa_[:, 0].astype(np.int32),
+            p_len=pa_[:, 1].astype(np.int32),
+            p_group=pa_[:, 2].astype(np.int32),
+            p_base=pa_[:, 3].astype(np.int32),
+            p_quota=pa_[:, 4].astype(np.int32),
+            p_syn=pa_[:, 5].astype(np.uint32),
+            p_isbase=pa_[:, 6].astype(bool),
             freq_weight=freqw, required=required, negative=negative,
             scored=scored, qlang=qplan.lang, matchable=matchable,
-            driver_df=0 if driver_df == 1 << 60 else int(driver_df))
+            driver_df=0 if driver_df == 1 << 60 else int(driver_df),
+            f2_eligible=f2_ok)
 
     # --- execution -------------------------------------------------------
 
@@ -642,8 +782,10 @@ class DeviceIndex:
         return self.search_batch([q], topk=topk, lang=lang)[0]
 
     def search_batch(self, queries, topk: int = 64, lang: int = 0):
-        """Batched execution: B queries in ONE device round trip (vmap
-        over the query axis), two-phase pruned scoring each."""
+        """Batched execution: B queries per device round trip (vmap over
+        the query axis). Routing: drivers with a bounded doc set use the
+        two-phase pruned kernel (F1); corpus-wide drivers go to the
+        full-cube exact kernel (F2) when every sublist fits it."""
         qplans = [q if isinstance(q, QueryPlan) else compile_query(q, lang)
                   for q in queries]
         plans = [self.plan(qp) for qp in qplans]
@@ -652,36 +794,94 @@ class DeviceIndex:
                    ] * len(plans)
         if not live:
             return results
-        kappa = min(_bucket(max(KAPPA_FLOOR, 2 * topk), KAPPA_FLOOR),
-                    self.D_cap)
+        # corpus-relative routing: a driver matching more than ~1/8th of
+        # the corpus (or CUBE_MIN_DF, whichever is smaller) prunes badly
+        # — full-cube scoring is cheaper than the escalation ladder
+        f2_cut = min(CUBE_MIN_DF, max(2 * KAPPA_FLOOR, self.n_docs // 8))
+        f2 = [i for i in live
+              if plans[i].driver_df > f2_cut and plans[i].f2_eligible]
+        f1 = [i for i in live if i not in set(f2)]
+
+        # wave loop: issue EVERY sub-batch dispatch, fetch ALL outputs
+        # in one device_get (one tunnel RTT), then parse; queries whose
+        # pruning check failed go into the (rare) next wave
         k_req = min(topk, self.D_cap)
-        pending = live
-        while pending:
-            k2 = min(k_req, kappa)
-            out = self._run_batch([plans[i] for i in pending], kappa, k2)
-            escalate = []
-            for row, i in zip(out, pending):
-                nm = int(row[0])
-                ub_missed = float(np.asarray(row[1:2]).view(np.float32)[0])
-                idx = row[2:2 + k2].astype(np.int64)
-                scores = np.asarray(row[2 + k2:]).view(np.float32)
-                keep = scores > 0.0
-                kth = float(scores[k_req - 1]) if (k2 >= k_req
-                                                   and keep[k_req - 1]
-                                                   ) else 0.0
-                if ub_missed > kth * _TIE_TOL and kappa < self.D_cap:
-                    escalate.append(i)
-                    continue
-                results[i] = (
-                    self.all_docids[np.clip(idx[keep], 0,
-                                            max(self.n_docs - 1, 0))],
-                    scores[keep], nm)
-            if not escalate:
-                break
-            self.escalations += len(escalate)
-            pending = escalate
-            kappa = min(kappa * 4, self.D_cap)
+        f2_exact = False
+        bmax = self._f2_bmax()
+        while f1 or f2:
+            waves = []
+            groups: dict[int, list[int]] = {}
+            for i in f1:
+                groups.setdefault(self._kappa_of(plans[i], topk),
+                                  []).append(i)
+            for kappa, idxs in sorted(groups.items()):
+                for a in range(0, len(idxs), 32):  # B buckets: {4, 32}
+                    chunk = idxs[a:a + 32]
+                    waves.append(("f1", kappa, chunk, self._run_batch(
+                        [plans[i] for i in chunk], kappa,
+                        min(k_req, kappa))))
+            for a in range(0, len(f2), bmax):
+                chunk = f2[a:a + bmax]
+                waves.append(("f2", 0, chunk, self._run_batch_f2(
+                    [plans[i] for i in chunk], k_req, exact=f2_exact)))
+            outs = jax.device_get([w[3] for w in waves])
+            f1_next: list[int] = []
+            f2_next: list[int] = []
+            for (kind, kappa, idxs, _), out in zip(waves, outs):
+                k2 = min(k_req, kappa) if kind == "f1" else k_req
+                for row, i in zip(out, idxs):
+                    nm, missed, idx, scores = self._parse_out(row, k2)
+                    kth = float(scores[k_req - 1]) if (
+                        k2 >= k_req and scores[k_req - 1] > 0.0) else 0.0
+                    if missed > kth * _TIE_TOL:
+                        if kind == "f1" and kappa < self.D_cap:
+                            # κ-grouping covers the driver's whole doc
+                            # set, so this is approx_max_k recall slip —
+                            # widen the rung and rerun
+                            plans[i].driver_df = min(4 * max(
+                                plans[i].driver_df, kappa), self.D_cap)
+                            f1_next.append(i)
+                            continue
+                        if kind == "f2" and not f2_exact:
+                            f2_next.append(i)
+                            continue
+                    self._emit(results, i, nm, idx, scores)
+            if f1_next or f2_next:
+                self.escalations += len(f1_next) + len(f2_next)
+            f1, f2 = f1_next, f2_next
+            f2_exact = True
         return results
+
+    def _parse_out(self, row, k2: int):
+        nm = int(row[0])
+        missed = float(np.asarray(row[1:2]).view(np.float32)[0])
+        idx = row[2:2 + k2].astype(np.int64)
+        scores = np.asarray(row[2 + k2:]).view(np.float32)
+        return nm, missed, idx, scores
+
+    def _emit(self, results, i, nm, idx, scores):
+        keep = scores > 0.0
+        results[i] = (
+            self.all_docids[np.clip(idx[keep], 0,
+                                    max(self.n_docs - 1, 0))],
+            scores[keep], nm)
+
+    def _kappa_of(self, p: ResidentPlan, topk: int) -> int:
+        """κ group for a plan: candidates ⊆ driver docs, so κ ≥
+        driver_df makes the candidate set complete — ub_missed is 0 by
+        construction and no escalation round ever runs. Three κ rungs
+        keep the compile-variant count tiny."""
+        need = max(KAPPA_FLOOR, 2 * topk, p.driver_df)
+        for rung in (KAPPA_FLOOR, 8 * KAPPA_FLOOR, 32 * KAPPA_FLOOR):
+            if need <= rung:
+                return min(rung, self.D_cap)
+        return min(_bucket(need, KAPPA_FLOOR), self.D_cap)
+
+    def _f2_bmax(self) -> int:
+        """F2 batch cap: full-cube intermediates are ~48 bytes/doc/query
+        ([T,P,D] cube+validity+scores) — bound them to ~768 MB."""
+        per_q = 48 * MAX_POSITIONS * self.D_cap
+        return max(4, min(32, (768 << 20) // max(per_q, 1)))
 
     def _run_batch(self, plans: list[ResidentPlan], kappa: int, k2: int):
         Rd = _bucket(max([len(p.d_slot) for p in plans] + [1]), RD_FLOOR)
@@ -689,7 +889,7 @@ class DeviceIndex:
         Lsp = _bucket(max([int(p.s_len.max()) if len(p.s_len) else 1
                            for p in plans] + [1]), LSP_FLOOR)
         T = max(len(p.required) for p in plans)
-        B = _bucket(len(plans), B_FLOOR)
+        B = B_FLOOR if len(plans) <= B_FLOOR else 32  # two B buckets only
 
         def pad_plan(p: ResidentPlan | None):
             if p is None:
@@ -718,14 +918,57 @@ class DeviceIndex:
         padded = [pad_plan(p) for p in plans] \
             + [pad_plan(None)] * (B - len(plans))
         args = [np.stack([p[j] for p in padded]) for j in range(17)]
-        dev_args = jax.device_put(args)
-        out = np.asarray(_two_phase(
+        # host args ride the (async) dispatch; returned WITHOUT fetching
+        # — the caller fetches every wave's output in ONE device_get
+        # (each separate blocking fetch costs a full ~100 ms tunnel RTT)
+        return _two_phase(
             self.d_payload, self.d_doc, self.d_imp, self.d_rsp,
             self.d_dense_imp, self.d_dense_rsp,
             self.d_siterank, self.d_doclang, self.d_dead,
-            np.int32(self.n_docs), *dev_args,
-            n_positions=self.P, lsp=Lsp, kappa=kappa, k2=k2))
-        return out
+            np.int32(self.n_docs), *args,
+            n_positions=self.P, lsp=Lsp, kappa=kappa, k2=k2)
+
+    def _run_batch_f2(self, plans: list[ResidentPlan], k2: int,
+                      exact: bool):
+        Rc = _bucket(max([len(p.c_slot) for p in plans] + [1]), RC_FLOOR)
+        Rp = _bucket(max([len(p.p_start) for p in plans] + [1]), RP_FLOOR)
+        Lp = _bucket(max([int(p.p_len.max()) if len(p.p_len) else 1
+                          for p in plans] + [1]), F2_LPOST_FLOOR)
+        T = max(len(p.required) for p in plans)
+        B = F2_B_FLOOR if len(plans) <= F2_B_FLOOR else self._f2_bmax()
+
+        def pad_plan(p: ResidentPlan | None):
+            if p is None:
+                return (np.full(Rc, -1, np.int32), np.zeros(Rc, np.int32),
+                        np.zeros(Rc, np.int32), np.zeros(Rc, np.int32),
+                        np.ones(Rc, np.int32), np.zeros(Rc, np.uint32),
+                        np.zeros(Rp, np.int32), np.zeros(Rp, np.int32),
+                        np.zeros(Rp, np.int32), np.zeros(Rp, np.int32),
+                        np.ones(Rp, np.int32), np.zeros(Rp, np.uint32),
+                        np.ones(Rp, bool),
+                        np.full(T, 0.5, np.float32), np.zeros(T, bool),
+                        np.zeros(T, bool), np.zeros(T, bool), np.int32(0))
+            pr = lambda a, n, fill: _pad1(a, n, fill)
+            return (pr(p.c_slot, Rc, -1), pr(p.c_dslot, Rc, 0),
+                    pr(p.c_group, Rc, 0), pr(p.c_base, Rc, 0),
+                    pr(p.c_quota, Rc, 1), pr(p.c_syn, Rc, 0),
+                    pr(p.p_start, Rp, 0), pr(p.p_len, Rp, 0),
+                    pr(p.p_group, Rp, 0), pr(p.p_base, Rp, 0),
+                    pr(p.p_quota, Rp, 1), pr(p.p_syn, Rp, 0),
+                    pr(p.p_isbase, Rp, True),
+                    _pad1(p.freq_weight, T, 0.5),
+                    _pad1(p.required, T, False),
+                    _pad1(p.negative, T, False),
+                    _pad1(p.scored, T, False), np.int32(p.qlang))
+
+        padded = [pad_plan(p) for p in plans] \
+            + [pad_plan(None)] * (B - len(plans))
+        args = [np.stack([p[j] for p in padded]) for j in range(18)]
+        return _full_cube(
+            self.d_payload, self.d_pdoc, self.d_pocc, self.d_cube,
+            self.d_dense_rsp, self.d_siterank, self.d_doclang,
+            self.d_dead, np.int32(self.n_docs), *args,
+            n_positions=self.P, lpost=Lp, k2=k2, exact=exact)
 
 
 @jax.jit
@@ -823,7 +1066,8 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
         ubfinal = jnp.where(alive, ubmin * mult * 1.00001, 0.0)
         nm = jnp.sum(alive)
 
-        cval, cand = jax.lax.approx_max_k(ubfinal, kappa)
+        cval, cand = jax.lax.approx_max_k(ubfinal, kappa,
+                                  recall_target=0.99)
         selmask = jnp.zeros((D,), bool).at[cand].set(True)
         ub_missed = jnp.max(jnp.where(selmask, 0.0, ubfinal))
 
@@ -884,3 +1128,116 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
                          s_start, s_len, s_group, s_base, s_quota, s_syn,
                          s_isbase, freqw, required, negative, scored,
                          qlang)
+
+
+@partial(jax.jit, static_argnames=("n_positions", "lpost", "k2", "exact"))
+def _full_cube(d_payload, d_pdoc, d_pocc, d_cube, d_dense_rsp,
+               d_siterank, d_doclang, d_dead, n_docs_total,
+               c_slot, c_dslot, c_group, c_base, c_quota, c_syn,
+               p_start, p_len, p_group, p_base, p_quota, p_syn, p_isbase,
+               freqw, required, negative, scored, qlang,
+               n_positions: int, lpost: int, k2: int, exact: bool):
+    """Full-corpus exact kernel (F2) for corpus-wide drivers.
+
+    Builds the [T, P, D] position cube over the WHOLE doc axis — the
+    heaviest terms from materialized cube rows (plain slices), the rest
+    by a bounded posting-granular scatter — then runs the exact
+    docIdLoop scoring (scorer.min_scores) on every doc at once. This is
+    the reference's intersectLists10_r docIdLoop with the loop axis
+    vectorized away; no pruning, no escalation ladder.
+    Output format matches _two_phase."""
+    D = d_dead.shape[0]
+    N = d_payload.shape[0]
+    P = n_positions
+    VcPD = d_cube.shape[0]
+    big = jnp.float32(9.99e8)
+
+    def one(c_slot, c_dslot, c_group, c_base, c_quota, c_syn,
+            p_start, p_len, p_group, p_base, p_quota, p_syn, p_isbase,
+            freqw, required, negative, scored, qlang):
+        T = required.shape[0]
+        Rc = c_slot.shape[0]
+        Rp = p_start.shape[0]
+        t_ax = jnp.arange(T)
+        live = ~d_dead
+        p_ax = jnp.arange(P, dtype=jnp.int32)[:, None]        # [P, 1]
+
+        cube = jnp.zeros((T, P, D), jnp.uint32)
+        pv = jnp.zeros((T, P, D), bool)
+        # materialized cube rows: slice + count-mask (cube rows are
+        # always base postings, so the dead vector masks them)
+        V = d_dense_rsp.shape[0] // D
+        for r in range(Rc):
+            gate = c_slot[r] >= 0
+            row = jax.lax.dynamic_slice(
+                d_cube, (jnp.clip(c_slot[r], 0, VcPD // (P * D) - 1)
+                         * P * D,), (P * D,)).reshape(P, D)
+            cnt = (jax.lax.dynamic_slice(
+                d_dense_rsp, (jnp.clip(c_dslot[r], 0, V - 1) * D,),
+                (D,)) & _CNT_MASK)
+            # shift the row to the sublist's slot range [base, base+quota)
+            # — occurrence q of the term lands in cube slot base+q
+            q = p_ax[:, 0] - c_base[r]                    # [P]
+            row = jnp.take(row, jnp.clip(q, 0, P - 1), axis=0)
+            pvr = ((q[:, None] >= 0)
+                   & (q[:, None]
+                      < jnp.minimum(cnt, c_quota[r])[None, :])
+                   & live[None, :] & gate)
+            val = row | (c_syn[r].astype(jnp.uint32) << jnp.uint32(31))
+            gmask = (c_group[r] == t_ax)[:, None, None]
+            cube = cube + jnp.where(pvr, val, jnp.uint32(0))[None] \
+                * gmask.astype(jnp.uint32)
+            pv = pv | (pvr[None] & gmask)
+        # posting-granular scatter rows (bigrams, deltas, small terms)
+        lane = jnp.arange(lpost, dtype=jnp.int32)
+        idx = p_start[:, None] + lane[None, :]                # [Rp, Lp]
+        m = lane[None, :] < p_len[:, None]
+        idxc = jnp.clip(idx, 0, N - 1)
+        doc = d_pdoc[idxc]
+        occ = d_pocc[idxc].astype(jnp.int32)
+        pay = (d_payload[idxc]
+               | (p_syn[:, None].astype(jnp.uint32) << jnp.uint32(31)))
+        dead_l = d_dead[jnp.clip(doc, 0, D - 1)]
+        ok = (m & (occ < p_quota[:, None])
+              & ~(dead_l & p_isbase[:, None]))
+        slot = p_base[:, None] + occ
+        tgt = jnp.where(ok, (p_group[:, None] * P + slot) * D + doc,
+                        T * P * D)
+        cube = cube.reshape(-1).at[tgt.ravel()].add(
+            jnp.where(ok, pay, jnp.uint32(0)).ravel(), mode="drop"
+        ).reshape(T, P, D)
+        pv = pv.reshape(-1).at[tgt.ravel()].set(
+            ok.ravel(), mode="drop").reshape(T, P, D)
+
+        sc = scored & required
+        min_sc, present = min_scores(cube, pv, freqw, sc)
+        req_ok = jnp.all(jnp.where(required[:, None], present, True),
+                         axis=0)
+        neg_ok = ~jnp.any(jnp.where(negative[:, None], present, False),
+                          axis=0)
+        match = (req_ok & neg_ok & (jnp.arange(D) < n_docs_total)
+                 & (min_sc < big))
+        final = jnp.where(
+            match, min_sc * final_multipliers(d_siterank, d_doclang,
+                                              qlang), 0.0)
+        nm = jnp.sum(match)
+        if exact:
+            ts, ti = jax.lax.top_k(final, k2)
+            missed = jnp.float32(0.0)
+        else:
+            ts, ti = jax.lax.approx_max_k(final, k2,
+                                          recall_target=0.98)
+            selmask = jnp.zeros((D,), bool).at[ti].set(True)
+            missed = jnp.max(jnp.where(selmask, 0.0, final))
+        return jnp.concatenate([
+            jnp.atleast_1d(nm.astype(jnp.uint32)),
+            jax.lax.bitcast_convert_type(jnp.atleast_1d(missed),
+                                         jnp.uint32),
+            ti.astype(jnp.uint32),
+            jax.lax.bitcast_convert_type(ts, jnp.uint32),
+        ])
+
+    return jax.vmap(one)(c_slot, c_dslot, c_group, c_base, c_quota,
+                         c_syn, p_start, p_len, p_group, p_base, p_quota,
+                         p_syn, p_isbase, freqw, required, negative,
+                         scored, qlang)

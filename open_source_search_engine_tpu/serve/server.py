@@ -93,9 +93,10 @@ class SearchHTTPServer:
     reference's public endpoints."""
 
     def __init__(self, base_dir, host: str = "127.0.0.1", port: int = 8000,
-                 sharded=None, spider=None):
+                 sharded=None, spider=None, cluster=None):
         self.colldb = CollectionDb(base_dir)
-        self.sharded = sharded  # ShardedCollection | None
+        self.sharded = sharded  # ShardedCollection | None (in-process mesh)
+        self.cluster = cluster  # ClusterClient | None (multi-process plane)
         self.spider = spider    # spider queue hook (addurl)
         self.host = host
         self.port = port
@@ -162,7 +163,9 @@ class SearchHTTPServer:
         n = min(int(query.get("n", 10)), 100)
         fmt = query.get("format", "json")
         self.stats["queries"] += 1
-        if self.sharded is not None:
+        if self.cluster is not None:
+            res = self.cluster.search(q, topk=n)
+        elif self.sharded is not None:
             from ..parallel import sharded_search
             res = sharded_search(self.sharded, q, topk=n)
         else:
@@ -175,7 +178,9 @@ class SearchHTTPServer:
         from ..build import docproc
         docid = int(query.get("d", "0"))
         self.stats["gets"] += 1
-        if self.sharded is not None:
+        if self.cluster is not None:
+            rec = self.cluster.get_document(docid)
+        elif self.sharded is not None:
             rec = self.sharded.get_document(docid)
         else:
             rec = docproc.get_document(self._coll(query), docid=docid)
@@ -200,6 +205,10 @@ class SearchHTTPServer:
         content = body.decode("utf-8", "replace") if body else \
             query.get("content", "")
         self.stats["injects"] += 1
+        if self.cluster is not None:
+            docid = self.cluster.index_document(url, content)
+            return 200, json.dumps({"docId": int(docid)}), \
+                "application/json"
         if self.sharded is not None:
             ml = self.sharded.index_document(url, content)
         else:

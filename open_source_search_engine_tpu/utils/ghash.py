@@ -11,6 +11,8 @@ the reference.
 
 from __future__ import annotations
 
+from functools import lru_cache
+
 import numpy as np
 
 _FNV_OFFSET = 0xCBF29CE484222325
@@ -38,13 +40,15 @@ def hash64(data: bytes | str, seed: int = 0) -> int:
     return h
 
 
+@lru_cache(maxsize=1 << 20)
 def term_id(word: str, prefix: str | None = None) -> int:
     """48-bit termId for a word, optionally field-prefixed.
 
     Mirrors the reference's prefixed-field hashing (``hashString`` with a
     prefix hash for e.g. ``site:``/``inurl:`` terms, ``XmlDoc.cpp:hashAll``):
     the prefix hash is mixed into the word hash so ``site:foo.com`` and the
-    plain body word occupy distinct termId spaces.
+    plain body word occupy distinct termId spaces. Cached: term vocabulary
+    is Zipf-distributed, so indexing rehashes the same words constantly.
     """
     h = hash64(word.lower())
     if prefix:
@@ -52,6 +56,7 @@ def term_id(word: str, prefix: str | None = None) -> int:
     return h & TERMID_MASK
 
 
+@lru_cache(maxsize=1 << 20)
 def bigram_id(w1: str, w2: str) -> int:
     """termId of the bigram "w1 w2" (reference: ``Phrases.cpp`` two-word
     phrase hashing — a combined hash of the two word hashes)."""

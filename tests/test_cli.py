@@ -1,0 +1,50 @@
+"""CLI tests — the reference's `gb` command verbs (main.cpp:1084-3887)
+as `python -m open_source_search_engine_tpu {inject,search,save,serve}`.
+
+The quickstart contract: inject docs, query, save, restart losslessly —
+all from a shell with no Python written.
+"""
+
+import json
+import subprocess
+import sys
+
+REPO = str(__import__("pathlib").Path(__file__).resolve().parent.parent)
+
+
+def run_cli(tmp_path, *argv: str, stdin: str | None = None):
+    proc = subprocess.run(
+        [sys.executable, "-m", "open_source_search_engine_tpu", *argv],
+        capture_output=True, text=True, input=stdin, cwd=tmp_path,
+        env={"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+             "PATH": "/usr/bin:/bin", "HOME": str(tmp_path)},
+        timeout=180)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_inject_search_save_restart(tmp_path):
+    out = run_cli(
+        tmp_path, "inject", "--dir", "d", "http://cli.test/a",
+        stdin="<html><head><title>Apple pie</title></head><body>"
+              "<p>apple pie recipe with cinnamon.</p></body></html>")
+    assert out["docs"] == 1 and out["docid"] > 0
+
+    out = run_cli(
+        tmp_path, "inject", "--dir", "d", "http://cli.test/b",
+        stdin="<html><head><title>Banana bread</title></head><body>"
+              "<p>banana bread recipe, moist.</p></body></html>")
+    assert out["docs"] == 2
+
+    out = run_cli(tmp_path, "search", "--dir", "d", "recipe", "--json")
+    assert out["total"] == 2
+    urls = {r["url"] for r in out["results"]}
+    assert urls == {"http://cli.test/a", "http://cli.test/b"}
+
+    out = run_cli(tmp_path, "save", "--dir", "d")
+    assert "main" in out["saved"]
+
+    # a fresh process (the restart) still sees everything
+    out = run_cli(tmp_path, "search", "--dir", "d", "banana", "--json")
+    assert out["total"] == 1
+    assert out["results"][0]["url"] == "http://cli.test/b"

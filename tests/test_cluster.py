@@ -1,0 +1,176 @@
+"""Multi-process host plane tests — the SURVEY §4.5 model made real:
+N node processes on loopback, a client routing by the shared key→shard
+maps, twin failover, degraded answers, and restart catch-up.
+
+Reference behaviors pinned here: Msg1 write-to-all-twins with
+retry-forever (Msg1.cpp:20), Multicast serving-twin pick with reroute
+(Multicast.cpp:520), PingServer liveness (PingServer.h:61), and the
+faq.html:586 recovery story (a restarted twin serves again).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = str(__import__("pathlib").Path(__file__).resolve().parent.parent)
+
+N_SHARDS = 2
+N_REPLICAS = 2
+
+DOCS = {
+    f"http://s.test/doc{i}": (
+        f"<html><head><title>Doc {i} cluster</title></head><body>"
+        f"<p>cluster words shared everywhere token{i}.</p></body></html>")
+    for i in range(12)
+}
+
+
+def _wait_port(port: int, timeout: float = 60.0) -> None:
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/rpc/ping", data=b"{}",
+                    timeout=1.0) as r:
+                if json.load(r).get("ok"):
+                    return
+        except Exception:
+            time.sleep(0.3)
+    raise TimeoutError(f"node on {port} never came up")
+
+
+class Nodes:
+    """Spawn/kill/restart the node processes of a loopback cluster."""
+
+    def __init__(self, tmp_path, ports):
+        self.tmp_path = tmp_path
+        self.ports = ports  # [shard][replica]
+        self.procs = {}
+
+    def dir_of(self, s, r):
+        return str(self.tmp_path / f"node_s{s}r{r}")
+
+    def start(self, s, r):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "open_source_search_engine_tpu",
+             "node", "--dir", self.dir_of(s, r),
+             "--port", str(self.ports[s][r])],
+            env={"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+                 "PATH": "/usr/bin:/bin", "HOME": str(self.tmp_path)},
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        self.procs[(s, r)] = proc
+
+    def kill(self, s, r):
+        p = self.procs.pop((s, r))
+        p.send_signal(signal.SIGKILL)
+        p.wait()
+
+    def stop_all(self):
+        for p in self.procs.values():
+            p.kill()
+        for p in self.procs.values():
+            p.wait()
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    import socket
+
+    from open_source_search_engine_tpu.parallel.cluster import (
+        ClusterClient, HostsConf)
+
+    ports = []
+    socks = []
+    for s in range(N_SHARDS):
+        row = []
+        for r in range(N_REPLICAS):
+            sk = socket.socket()
+            sk.bind(("127.0.0.1", 0))
+            row.append(sk.getsockname()[1])
+            socks.append(sk)
+        ports.append(row)
+    for sk in socks:
+        sk.close()
+
+    nodes = Nodes(tmp_path, ports)
+    for s in range(N_SHARDS):
+        for r in range(N_REPLICAS):
+            nodes.start(s, r)
+    for s in range(N_SHARDS):
+        for r in range(N_REPLICAS):
+            _wait_port(ports[s][r])
+
+    conf = HostsConf.parse(
+        f"num-mirrors: {N_REPLICAS - 1}\n" + "\n".join(
+            f"127.0.0.1:{ports[s][r]}"
+            for r in range(N_REPLICAS) for s in range(N_SHARDS)))
+    client = ClusterClient(conf, use_heartbeat=False)
+    try:
+        yield nodes, client
+    finally:
+        client.close()
+        nodes.stop_all()
+
+
+def _search_urls(client, q, **kw):
+    kw.setdefault("site_cluster", False)
+    res = client.search(q, **kw)
+    return res, {r.url for r in res.results}
+
+
+@pytest.mark.slow
+def test_cluster_end_to_end(cluster):
+    nodes, client = cluster
+
+    # --- writes fan out to all twins; search spans shards ---
+    for url, html in DOCS.items():
+        client.index_document(url, html)
+    assert client.pending_writes == 0
+    res, urls = _search_urls(client, "cluster words", topk=12)
+    assert res.total_matches == len(DOCS)
+    assert not res.degraded
+    assert urls == set(DOCS)
+
+    # --- kill ONE twin of shard 0: reroute serves everything ---
+    nodes.kill(0, 0)
+    res, urls = _search_urls(client, "cluster words", topk=12)
+    assert res.total_matches == len(DOCS)
+    assert not res.degraded          # the twin covers the shard
+    assert urls == set(DOCS)
+
+    # a write while the twin is down parks in the retry queue
+    client.index_document(
+        "http://s.test/late",
+        "<html><head><title>Late arrival</title></head><body>"
+        "<p>cluster latecomer token99.</p></body></html>")
+    res, urls = _search_urls(client, "latecomer", topk=5)
+    late_shard = int(client.hostmap.shard_of_docid(
+        __import__("open_source_search_engine_tpu.utils.ghash",
+                   fromlist=["doc_id"]).doc_id("http://s.test/late")))
+    assert "http://s.test/late" in urls
+
+    # --- kill the OTHER twin too: whole shard down → degraded ---
+    nodes.kill(0, 1)
+    res, urls = _search_urls(client, "cluster words", topk=12)
+    assert res.degraded
+    assert 0 < len(urls) < len(DOCS)
+
+    # --- restart one twin: its durable state + the retry queue catch
+    # it up; the shard serves again ---
+    nodes.start(0, 0)
+    _wait_port(nodes.ports[0][0])
+    deadline = time.time() + 30
+    while client.pending_writes and time.time() < deadline:
+        time.sleep(0.5)
+    res, urls = _search_urls(client, "cluster words", topk=12)
+    assert not res.degraded
+    assert urls == set(DOCS)
+    if late_shard == 0:
+        res, urls = _search_urls(client, "latecomer", topk=5)
+        assert "http://s.test/late" in urls
