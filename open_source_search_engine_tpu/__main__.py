@@ -44,6 +44,11 @@ def cmd_serve(args) -> int:
     if args.hosts:
         from .parallel.cluster import ClusterClient, HostsConf
         cluster = ClusterClient(HostsConf.load(args.hosts))
+        if args.spider:
+            print("--spider is ignored with --hosts: crawled pages "
+                  "would land in the local collection while searches "
+                  "go to the cluster", file=sys.stderr)
+            args.spider = False
     srv = SearchHTTPServer(args.dir, host=args.host, port=args.port,
                            cluster=cluster)
     coll = srv.colldb.get(args.coll)

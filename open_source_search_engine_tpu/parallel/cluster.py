@@ -170,9 +170,10 @@ class ShardNodeServer:
         from ..build import docproc
         from ..query import engine
 
+        if path == "/rpc/ping":
+            # lock-free: a long write/checkpoint must not fail heartbeats
+            return {"ok": True, "docs": self.coll.num_docs}
         with self._lock:
-            if path == "/rpc/ping":
-                return {"ok": True, "docs": self.coll.num_docs}
             if path == "/rpc/index":
                 self._journal_write({"url": payload["url"],
                                      "content": payload["content"]})
@@ -413,16 +414,22 @@ class ClusterClient:
     def _send_one(self, shard: int, r: int, p: _Pending) -> None:
         q = self._queues[(shard, r)]
         with q.lock:
-            backlog = bool(q.items)
-            if backlog:
-                # ordering: never overtake parked writes to this host
+            # ordering: never overtake parked writes OR an in-flight
+            # send/drain to this host — concurrent direct sends could
+            # otherwise land out of order and newest-wins would keep a
+            # stale version
+            if q.items or q.in_flight:
                 q.items.append(p)
-        if backlog:
-            return
-        if not self._deliver(p):
-            self.hostmap.mark_dead(shard, r)
+                return
+            q.in_flight = True
+        try:
+            if not self._deliver(p):
+                self.hostmap.mark_dead(shard, r)
+                with q.lock:
+                    q.items.insert(0, p)
+        finally:
             with q.lock:
-                q.items.append(p)
+                q.in_flight = False
 
     def _write_all_twins(self, shard: int, path: str, payload: dict
                          ) -> None:
@@ -502,8 +509,15 @@ class ClusterClient:
         order = np.argsort(-np.asarray(scores, dtype=np.float64),
                            kind="stable")
         plan = compile_query(q, lang=lang)
+        # prefetch the likely titlerecs concurrently (the reference
+        # launches its Msg20 summary requests in parallel,
+        # Msg40::launchMsg20s); build_results then reads the cache
+        want = [docids[i] for i in order[: topk + 8]]
+        fetched = dict(zip(want, self._pool.map(self.get_document, want)))
+        get_doc = lambda d: fetched.get(d) if d in fetched \
+            else self.get_document(d)
         results, clustered = build_results(
-            self.get_document,
+            get_doc,
             [docids[i] for i in order], [scores[i] for i in order],
             plan, topk=topk, with_snippets=with_snippets,
             site_cluster=site_cluster)

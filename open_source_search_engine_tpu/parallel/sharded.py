@@ -41,6 +41,7 @@ from ..query import weights
 from ..query.compiler import QueryPlan, compile_query
 from ..query.engine import SearchResults, build_results
 from ..query.packer import (MAX_POSITIONS, PackedQuery, PreparedQuery,
+                            pad_table,
                             _pad1, group_flags, pack_pass, prepare_query)
 from ..query.scorer import score_core
 from ..utils.log import get_logger
@@ -232,7 +233,7 @@ def _pad_packed(pq: PackedQuery | None, T: int, L: int, D: int,
     """Pad one shard's pack to the fleet-wide (T, L, D) bucket; ``None``
     becomes an all-invalid dummy block (empty Msg39 reply)."""
     if pq is None:
-        required, negative, scored = group_flags(plan, T)
+        required, negative, scored, counts = group_flags(plan, T)
         return PackedQuery(
             doc_idx=np.full((T, L), D, np.int32),
             payload=np.zeros((T, L), np.uint32),
@@ -240,6 +241,7 @@ def _pad_packed(pq: PackedQuery | None, T: int, L: int, D: int,
             valid=np.zeros((T, L), bool),
             freq_weight=_pad1(freqw, T, 0.5),
             required=required, negative=negative, scored=scored,
+            counts=counts, table=pad_table(plan.bool_table),
             cand_docids=np.empty(0, np.uint64),
             siterank=np.zeros(D, np.int32), doclang=np.zeros(D, np.int32),
             n_docs=0, qlang=plan.lang)
@@ -264,7 +266,8 @@ def _pad_packed(pq: PackedQuery | None, T: int, L: int, D: int,
         doc_idx=doc_idx, payload=payload, slot=slot, valid=valid,
         freq_weight=_pad1(freqw, T, 0.5),
         required=pq.required, negative=pq.negative,
-        scored=pq.scored, cand_docids=pq.cand_docids,
+        scored=pq.scored, counts=pq.counts, table=pq.table,
+        cand_docids=pq.cand_docids,
         siterank=siterank, doclang=doclang, n_docs=pq.n_docs,
         qlang=pq.qlang)
 
@@ -272,7 +275,8 @@ def _pad_packed(pq: PackedQuery | None, T: int, L: int, D: int,
 @partial(jax.jit, static_argnames=("mesh", "local_k", "out_k",
                                    "n_positions"))
 def _sharded_score(mesh, doc_idx, payload, slot, valid, freq_weight,
-                   required, negative, scored, siterank, doclang, qlang,
+                   required, negative, scored, counts, table, siterank,
+                   doclang, qlang,
                    n_docs, local_k: int, out_k: int,
                    n_positions: int = MAX_POSITIONS):
     """shard_map program: per-shard intersect+score, in-mesh top-k merge.
@@ -286,10 +290,11 @@ def _sharded_score(mesh, doc_idx, payload, slot, valid, freq_weight,
     spec = P(SHARD_AXIS)
     rep = P()
 
-    def per_shard(di, pl, sl, va, fw, rq, ng, sc, sr, dl, ql, nd):
+    def per_shard(di, pl, sl, va, fw, rq, ng, sc, ct, tb, sr, dl, ql,
+                  nd):
         n_matched, ts, ti = score_core(
             di[0], pl[0], sl[0], va[0], fw[0], rq[0], ng[0], sc[0],
-            sr[0], dl[0], ql[0], nd[0],
+            ct[0], tb[0], sr[0], dl[0], ql[0], nd[0],
             n_positions=n_positions, topk=local_k)
         k = ts.shape[0]
         # Msg3a merge as an ICI collective: gather every shard's top-k,
@@ -312,11 +317,11 @@ def _sharded_score(mesh, doc_idx, payload, slot, valid, freq_weight,
 
     return jax.shard_map(
         per_shard, mesh=mesh,
-        in_specs=(spec,) * 12,
+        in_specs=(spec,) * 14,
         out_specs=rep,
         check_vma=False,
     )(doc_idx, payload, slot, valid, freq_weight, required, negative,
-      scored, siterank, doclang, qlang, n_docs)
+      scored, counts, table, siterank, doclang, qlang, n_docs)
 
 
 def _global_freq_weights(preps: list[PreparedQuery | None],
@@ -375,6 +380,8 @@ def sharded_search(sc: ShardedCollection, q: str | QueryPlan, *,
         required=stack(lambda p: p.required),
         negative=stack(lambda p: p.negative),
         scored=stack(lambda p: p.scored),
+        counts=stack(lambda p: p.counts),
+        table=stack(lambda p: p.table),
         siterank=stack(lambda p: p.siterank),
         doclang=stack(lambda p: p.doclang),
         qlang=np.full(sc.n_shards, plan.lang, np.int32),
@@ -400,6 +407,7 @@ def sharded_search(sc: ShardedCollection, q: str | QueryPlan, *,
             sharded_args["slot"], sharded_args["valid"],
             sharded_args["freq_weight"], sharded_args["required"],
             sharded_args["negative"], sharded_args["scored"],
+            sharded_args["counts"], sharded_args["table"],
             sharded_args["siterank"], sharded_args["doclang"],
             sharded_args["qlang"], sharded_args["n_docs"],
             local_k=k, out_k=kk))

@@ -22,6 +22,20 @@ trees and the ~100 SearchInput parms come with the API layer):
   (scored=False — it gates matching but stays out of the min-score; the
   reference carries fielded terms through scoring, but a constant-position
   field term under the min-algorithm would dominate every query)
+* **boolean expressions** — ``a AND (b OR c) AND NOT d`` with uppercase
+  operators and parentheses (reference ``Query.h:266``: boolean queries
+  compile to truth tables over term-presence bits). Here likewise: the
+  expression compiles to a :attr:`QueryPlan.bool_table` — a
+  ``[2^T]`` bool lookup indexed by the packed per-doc presence bits —
+  which every execution path (host-packed, resident two-phase,
+  full-cube, sharded) evaluates as one tiny gather. Scoring under a
+  boolean query is the min over *present* scored groups (the
+  reference's behavior: required-ness is meaningless under OR).
+* **synonym sublists** — plain words carry morphological conjugates
+  (plural/verb forms, reference ``Synonyms.cpp`` FORM_CONJUGATE /
+  ``Posdb.h:21``) as SUB_SYNONYM sublists scoring ×SYNONYM_WEIGHT=0.90;
+  slot quotas are asymmetric so variants never starve the primary word
+  out of the position budget.
 
 Groups carry ``qpos`` (query word index); pair scoring uses the reference's
 default qdist=2 ("get query words as close together as possible",
@@ -32,6 +46,8 @@ from __future__ import annotations
 
 import re
 from dataclasses import dataclass, field
+
+import numpy as np
 
 from ..utils import ghash
 
@@ -67,6 +83,13 @@ SUB_SYNONYM = 2
 #: the reference caps sublists too (MAX_SUBLISTS, Posdb.h)
 MAX_GROUP_SUBLISTS = 16
 
+#: max leaves in a boolean expression (truth table = 2^T entries; the
+#: reference's tables cover 16 terms via 64k bitvecs, Query.h:266)
+MAX_BOOL_TERMS = 10
+
+#: synonym conjugates attached per word (Synonyms.cpp caps too)
+MAX_SYNONYMS = 3
+
 
 @dataclass
 class Sublist:
@@ -90,12 +113,33 @@ class TermGroup:
     def termids(self) -> list[int]:
         return [s.termid for s in self.sublists]
 
+    def slot_plan(self, max_positions: int = 16) -> list[tuple[int, int]]:
+        """[(slot_base, quota)] per sublist: the ORIGINAL word keeps at
+        least half the position budget; bigram/synonym variants split
+        the rest (a spammy variant must never starve the primary word —
+        the reference's mini-merge buffers are per-sublist too)."""
+        subs = self.sublists
+        if len(subs) <= 1:
+            return [(0, max_positions)] * len(subs)
+        n_var = len(subs) - 1
+        prim = max(max_positions // 2, 1)
+        var = max((max_positions - prim) // n_var, 1)
+        out = []
+        base = 0
+        for s in subs:
+            q = prim if s.kind == SUB_ORIGINAL else var
+            out.append((min(base, max_positions - 1), q))
+            base += q
+        return out
+
 
 @dataclass
 class QueryPlan:
     raw: str
     groups: list[TermGroup] = field(default_factory=list)
     lang: int = 0  # 0 = any (reference &qlang)
+    #: boolean truth table over presence bits (None = plain conjunctive)
+    bool_table: np.ndarray | None = None
 
     @property
     def scored_groups(self) -> list[TermGroup]:
@@ -106,9 +150,20 @@ class QueryPlan:
         return len(self.groups)
 
 
+#: boolean operator detector: uppercase keywords, reference style
+#: ("boolean operators must be in UPPER CASE", html/syntax.html)
+_BOOL_RE = re.compile(r"(?:^|[\s(])(AND|OR|NOT)(?:[\s)]|$)")
+
+
 def compile_query(q: str, lang: int = 0,
-                  bigrams: bool = True) -> QueryPlan:
+                  bigrams: bool = True,
+                  synonyms: bool = True) -> QueryPlan:
     """Compile a query string into a :class:`QueryPlan`."""
+    if _BOOL_RE.search(q):
+        try:
+            return _compile_boolean(q, lang, synonyms)
+        except ValueError:
+            pass  # malformed boolean → fall through as plain words
     plan = QueryPlan(raw=q, lang=lang)
     qpos = 0
     plain_words: list[tuple[int, str]] = []  # (group index, word)
@@ -128,7 +183,7 @@ def compile_query(q: str, lang: int = 0,
             else:
                 # unknown field → treat the value as plain words
                 for w in _WORD_RE.findall(fval.lower()):
-                    plan.groups.append(_word_group(w, qpos, neg))
+                    plan.groups.append(_word_group(w, qpos, neg, synonyms))
                     if not neg:
                         plain_words.append((len(plan.groups) - 1, w))
                     qpos += 1
@@ -149,7 +204,7 @@ def compile_query(q: str, lang: int = 0,
                 qpos += len(words)
                 continue
             for i, w in enumerate(words):
-                plan.groups.append(_word_group(w, qpos, neg))
+                plan.groups.append(_word_group(w, qpos, neg, synonyms))
                 qpos += 1
                 if i + 1 < len(words):
                     # adjacency gate: the indexed bigram term must match too
@@ -160,7 +215,7 @@ def compile_query(q: str, lang: int = 0,
                         negative=neg, scored=False, qpos=qpos))
         else:
             w = m.group("word").lower()
-            plan.groups.append(_word_group(w, qpos, neg))
+            plan.groups.append(_word_group(w, qpos, neg, synonyms))
             if not neg:
                 plain_words.append((len(plan.groups) - 1, w))
             qpos += 1
@@ -175,8 +230,199 @@ def compile_query(q: str, lang: int = 0,
     return plan
 
 
-def _word_group(word: str, qpos: int, neg: bool) -> TermGroup:
-    return TermGroup(
-        display=word,
-        sublists=[Sublist(ghash.term_id(word), SUB_ORIGINAL, word)],
-        negative=neg, qpos=qpos)
+def _conjugates(w: str) -> list[str]:
+    """Morphological variants (reference Synonyms.cpp FORM_CONJUGATE —
+    plural/singular and simple verb forms; the full Wiktionary synonym
+    sets are a data file away, the machinery is identical)."""
+    out: list[str] = []
+
+    def add(x):
+        if x and x != w and x not in out:
+            out.append(x)
+
+    if w.endswith("ies") and len(w) > 4:
+        add(w[:-3] + "y")
+    elif w.endswith("sses"):
+        add(w[:-2])
+    elif w.endswith("es") and len(w) > 3:
+        add(w[:-2])
+        add(w[:-1])
+    elif w.endswith("s") and not w.endswith("ss") and len(w) > 3:
+        add(w[:-1])
+    else:
+        if w.endswith("y") and len(w) > 3:
+            add(w[:-1] + "ies")
+        add(w + "s")
+    if w.endswith("ing") and len(w) > 5:
+        base = w[:-3]
+        add(base)
+        add(base + "e")
+        if len(base) > 2 and base[-1] == base[-2]:
+            add(base[:-1])  # running → run
+    elif w.endswith("ed") and len(w) > 4:
+        add(w[:-2])
+        add(w[:-1])
+        if len(w) > 5 and w[-3] == w[-4]:
+            add(w[:-3])     # stopped → stop
+    return out[:MAX_SYNONYMS]
+
+
+def _word_group(word: str, qpos: int, neg: bool,
+                synonyms: bool = True) -> TermGroup:
+    subs = [Sublist(ghash.term_id(word), SUB_ORIGINAL, word)]
+    if synonyms and not neg:
+        # negatives stay literal: "-apple" must not exclude "apples"
+        subs += [Sublist(ghash.term_id(c), SUB_SYNONYM, c)
+                 for c in _conjugates(word)]
+    return TermGroup(display=word, sublists=subs, negative=neg, qpos=qpos)
+
+
+# ---------------------------------------------------------------------------
+# boolean expressions (Query.h:266 truth tables)
+# ---------------------------------------------------------------------------
+
+class _BoolParser:
+    """Recursive descent over ``expr := and (OR and)*``,
+    ``and := unary ((AND)? unary)*``, ``unary := NOT unary | (expr) |
+    term`` — implicit adjacency inside a clause is AND, like the
+    reference's default boolean mode."""
+
+    def __init__(self, tokens: list[str], synonyms: bool = True):
+        self.toks = tokens
+        self.i = 0
+        self.synonyms = synonyms
+        self.leaves: list[TermGroup] = []
+
+    def peek(self):
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self):
+        t = self.peek()
+        self.i += 1
+        return t
+
+    def parse(self):
+        node = self.parse_or()
+        if self.peek() is not None:
+            raise ValueError(f"trailing tokens at {self.peek()!r}")
+        return node
+
+    def parse_or(self):
+        node = self.parse_and()
+        while self.peek() == "OR":
+            self.next()
+            node = ("or", node, self.parse_and())
+        return node
+
+    def parse_and(self):
+        node = self.parse_unary()
+        while (t := self.peek()) is not None and t not in ("OR", ")"):
+            if t == "AND":
+                self.next()
+            node = ("and", node, self.parse_unary())
+        return node
+
+    def parse_unary(self):
+        t = self.peek()
+        if t is None:
+            raise ValueError("unexpected end of expression")
+        if t == "NOT":
+            self.next()
+            return ("not", self.parse_unary())
+        if t == "(":
+            self.next()
+            node = self.parse_or()
+            if self.next() != ")":
+                raise ValueError("unbalanced parenthesis")
+            return node
+        tok = self.next()
+        # minus-negation inside a boolean expression = NOT (the
+        # conjunctive path's exclude semantics, Query.cpp sign parsing)
+        if tok.startswith("-") and len(tok) > 1:
+            return ("not", ("leaf", self._leaf(tok[1:])))
+        return ("leaf", self._leaf(tok))
+
+    def _leaf(self, tok: str) -> int:
+        if len(self.leaves) >= MAX_BOOL_TERMS:
+            raise ValueError("too many boolean terms")
+        m = _TOKEN_RE.match(tok)
+        if m is None:
+            raise ValueError(f"bad term {tok!r}")
+        if m.group("field") is not None:
+            fname = m.group("field").lower()
+            fval = m.group("fval").strip('"')
+            if fname in FILTER_FIELDS:
+                tid = ghash.term_id(fval, prefix=FILTER_FIELDS[fname])
+                g = TermGroup(display=f"{fname}:{fval}",
+                              sublists=[Sublist(tid, SUB_ORIGINAL)],
+                              scored=False)
+            else:
+                g = _word_group(fval.lower(), 0, False, self.synonyms)
+        elif m.group("quote") is not None:
+            words = [w.lower() for w in
+                     _WORD_RE.findall(m.group("quote"))]
+            # one group per phrase: the bigram chain gates adjacency
+            subs = ([Sublist(ghash.term_id(words[0]), SUB_ORIGINAL,
+                             words[0])] if len(words) == 1 else
+                    [Sublist(ghash.bigram_id(a, b), SUB_BIGRAM,
+                             f"{a} {b}")
+                     for a, b in zip(words, words[1:])
+                     ][:MAX_GROUP_SUBLISTS])
+            g = TermGroup(display='"' + " ".join(words) + '"',
+                          sublists=subs)
+        else:
+            g = _word_group(m.group("word").lower(), 0, False,
+                            self.synonyms)
+        g.qpos = len(self.leaves)
+        g.required = False  # the truth table owns match semantics
+        self.leaves.append(g)
+        return len(self.leaves) - 1
+
+
+def _eval_node(node, bits: int) -> bool:
+    op = node[0]
+    if op == "leaf":
+        return bool(bits >> node[1] & 1)
+    if op == "not":
+        return not _eval_node(node[1], bits)
+    a = _eval_node(node[1], bits)
+    b = _eval_node(node[2], bits)
+    return (a and b) if op == "and" else (a or b)
+
+
+def _leaf_polarity(node, neg: bool, out: dict) -> None:
+    op = node[0]
+    if op == "leaf":
+        out[node[1]] = out.get(node[1], False) or neg
+    elif op == "not":
+        _leaf_polarity(node[1], not neg, out)
+    else:
+        _leaf_polarity(node[1], neg, out)
+        _leaf_polarity(node[2], neg, out)
+
+
+def _compile_boolean(q: str, lang: int, synonyms: bool = True
+                     ) -> QueryPlan:
+    toks = re.findall(r"\(|\)|\"[^\"]*\"|[^\s()]+", q)
+    parser = _BoolParser(toks, synonyms)
+    tree = parser.parse()
+    if not parser.leaves:
+        raise ValueError("no terms")
+    # leaves under a NOT stay literal: presence of a conjugate must not
+    # exclude a doc the literal term doesn't appear in
+    polarity: dict[int, bool] = {}
+    _leaf_polarity(tree, False, polarity)
+    for li, negged in polarity.items():
+        if negged:
+            parser.leaves[li].sublists = [
+                sl for sl in parser.leaves[li].sublists
+                if sl.kind != SUB_SYNONYM] or parser.leaves[li].sublists
+    T = len(parser.leaves)
+    table = np.array([_eval_node(tree, bits) for bits in range(1 << T)],
+                     dtype=bool)
+    if table[0]:
+        # matches-on-empty-presence (e.g. pure NOT): unservable, like
+        # the reference's rejection of unbound negative queries
+        raise ValueError("boolean query matches the empty set")
+    return QueryPlan(raw=q, lang=lang, groups=parser.leaves,
+                     bool_table=table)

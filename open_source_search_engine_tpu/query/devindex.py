@@ -85,6 +85,7 @@ the reference's per-host index splits.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from functools import partial
 
@@ -98,15 +99,15 @@ from ..index.rdblite import merge_batches
 from ..utils.log import get_logger
 from . import weights
 from .compiler import SUB_SYNONYM, QueryPlan, compile_query
-from .packer import (MAX_POSITIONS, T_FLOOR, _bucket, _pad1, group_flags,
-                     pack_payload)
-from .scorer import final_multipliers, min_scores
+from .packer import (MAX_POSITIONS, T_FLOOR, TABLE_SIZE, _bucket, _pad1,
+                     group_flags, pack_payload, pad_table)
+from .scorer import final_multipliers, min_scores, presence_table_ok
 
 log = get_logger("devindex")
 
 #: shape-bucket floors (distinct shape tuples = one XLA compile each)
-RD_FLOOR = 8      # dense rows
-RS_FLOOR = 8      # sparse rows
+RD_FLOOR = 4      # dense rows
+RS_FLOOR = 4      # sparse rows
 LSP_FLOOR = 2048  # sparse gather lanes — single bucket when the dense
                   # threshold (D_cap//64) keeps every sparse run under it
 B_FLOOR = 4
@@ -126,16 +127,18 @@ DENSE_MIN_DF = 1024
 CUBE_BUDGET_BYTES = 768 << 20
 
 #: routing: drivers at or below this df use phase-1 pruning (F1);
-#: bigger drivers go to the full-cube kernel (F2) when eligible
-CUBE_MIN_DF = 8192
+#: bigger drivers go to the full-cube kernel (F2), whose cost is flat
+#: in the driver size (F1's phase-2 gathers scale with κ ≥ driver_df —
+#: measured 4× slower at κ=8192 than the whole F2 kernel)
+CUBE_MIN_DF = 2048
 
 #: F2 eligibility: non-cube sublists must scatter at most this many
 #: postings (the per-row scatter lane bucket cap)
 F2_SCATTER_MAX = 16384
 F2_LPOST_FLOOR = 4096
 F2_B_FLOOR = 4
-RC_FLOOR = 8
-RP_FLOOR = 8
+RC_FLOOR = 4
+RP_FLOOR = 4
 
 #: posting/doc column padding quantum
 COL_QUANTUM = 1 << 15
@@ -236,6 +239,37 @@ def _write_tail(buf, tail, offset):
     return jax.lax.dynamic_update_slice(buf, tail, (offset,))
 
 
+def _block_top2(x, n_sel: int):
+    """Top-2-per-block candidate selection: (vals [n_sel], idx [n_sel],
+    missed_max) — n_sel/2 blocks of size D/(n_sel/2), the two best docs
+    of each block selected, ``missed_max`` = the best value NOT selected
+    (3rd-best over any block).
+
+    This replaces ``lax.top_k``/``approx_max_k`` for candidate selection:
+    both lower to sort-like programs that cost 300 ms-2.4 s per batch on
+    a [B, 131072] score axis (measured), while this is six reshaped
+    max-reduces (~2 ms). Selection can miss a doc only when ≥3 candidates
+    share one block; the caller compares ``missed_max`` against its
+    result floor and escalates with more blocks — the same lossless
+    pruning contract as everywhere else."""
+    D = x.shape[0]
+    nb = max(n_sel // 2, 1)
+    R = D // nb
+    xb = x.reshape(nb, R)
+    iota = jnp.arange(R, dtype=jnp.int32)[None, :]
+    m1 = jnp.max(xb, axis=1)
+    a1 = jnp.argmax(xb, axis=1).astype(jnp.int32)
+    x2 = jnp.where(iota == a1[:, None], -jnp.inf, xb)
+    m2 = jnp.max(x2, axis=1)
+    a2 = jnp.argmax(x2, axis=1).astype(jnp.int32)
+    x3 = jnp.where(iota == a2[:, None], -jnp.inf, x2)
+    missed = jnp.maximum(jnp.max(x3), 0.0)
+    base = jnp.arange(nb, dtype=jnp.int32) * R
+    vals = jnp.concatenate([m1, jnp.maximum(m2, 0.0)])
+    idx = jnp.concatenate([base + a1, base + a2])
+    return vals, idx, missed
+
+
 @partial(jax.jit, static_argnames=("total",))
 def _build_cube_rows(payload, src, dst, total: int):
     """Materialize the cube rows device-side: one scatter of the cube
@@ -287,10 +321,11 @@ class ResidentPlan:
     required: np.ndarray     # bool [T]
     negative: np.ndarray     # bool [T]
     scored: np.ndarray       # bool [T]
+    counts: np.ndarray       # bool [T] groups entering the min-score
+    table: np.ndarray        # bool [TABLE_SIZE] boolean truth table
     qlang: int
     matchable: bool
     driver_df: int = 0       # min required-group df (routes F1 vs F2)
-    f2_eligible: bool = False  # every non-cube run scatters ≤ F2 cap
 
 
 class DeviceIndex:
@@ -696,17 +731,17 @@ class DeviceIndex:
         drows, srows, crows, prows = [], [], [], []
         dfs = np.zeros(max(len(qplan.groups), 1), np.int64)
         matchable = True
-        f2_ok = True
         any_required = False
         driver_df = 1 << 60
+        groups_have_postings = []
         for g_i, g in enumerate(qplan.groups):
             subs = g.sublists
-            quota = max(self.P // max(len(subs), 1), 1)
+            sp = g.slot_plan(self.P)
             any_postings = False
             gdf = 0
             for s_i, sub in enumerate(subs):
                 syn = 1 if sub.kind == SUB_SYNONYM else 0
-                base = s_i * quota
+                base, quota = sp[s_i]
                 for is_base, a, ln, dslot, cslot, pa, pl in \
                         self._druns_of(sub.termid):
                     # F1 row split: dense [D] impact row vs sparse run
@@ -716,27 +751,44 @@ class DeviceIndex:
                         srows.append((a, ln, g_i, base, quota, syn,
                                       is_base))
                     # F2 row split: materialized cube slice vs posting
-                    # scatter (bounded lanes)
+                    # scatter; oversized runs split into several bounded
+                    # scatter rows (postings carry their own doc+occ, so
+                    # any partition of the range is valid) — every query
+                    # is F2-servable and the F1 κ ladder stays ≤ the
+                    # routing cut
                     if cslot >= 0:
                         crows.append((cslot, dslot, g_i, base, quota,
                                       syn))
-                    elif pl <= F2_SCATTER_MAX:
-                        prows.append((pa, pl, g_i, base, quota, syn,
-                                      is_base))
                     else:
-                        f2_ok = False
+                        for off in range(0, pl, F2_SCATTER_MAX):
+                            prows.append((pa + off,
+                                          min(pl - off, F2_SCATTER_MAX),
+                                          g_i, base, quota, syn,
+                                          is_base))
                     any_postings = True
                 gdf = max(gdf, self._df_of(sub.termid))
             dfs[g_i] = gdf
+            groups_have_postings.append(any_postings)
             if g.required and not g.negative:
                 any_required = True
                 driver_df = min(driver_df, gdf)
                 if not any_postings:
                     matchable = False
-        if not any_required:
+        if qplan.bool_table is not None:
+            # a boolean query is servable iff SOME satisfying presence
+            # assignment uses only groups that have postings; the match
+            # bound is the union of all groups (any satisfying doc has
+            # ≥1 present group — table[0] is False by construction)
+            tbl = qplan.bool_table
+            bits = np.arange(len(tbl))
+            havemask = sum(1 << i for i, h in
+                           enumerate(groups_have_postings) if h)
+            matchable = bool(tbl[(bits & ~havemask) == 0].any())
+            driver_df = int(min(dfs.sum(), self.coll.num_docs or dfs.sum()))
+        elif not any_required:
             matchable = False
 
-        required, negative, scored = group_flags(qplan, T)
+        required, negative, scored, counts = group_flags(qplan, T)
         freqw = _pad1(
             weights.term_freq_weight(dfs[: len(qplan.groups)],
                                      max(self.coll.num_docs, 1)), T, 0.5)
@@ -771,9 +823,10 @@ class DeviceIndex:
             p_syn=pa_[:, 5].astype(np.uint32),
             p_isbase=pa_[:, 6].astype(bool),
             freq_weight=freqw, required=required, negative=negative,
-            scored=scored, qlang=qplan.lang, matchable=matchable,
-            driver_df=0 if driver_df == 1 << 60 else int(driver_df),
-            f2_eligible=f2_ok)
+            scored=scored, counts=counts,
+            table=pad_table(qplan.bool_table),
+            qlang=qplan.lang, matchable=matchable,
+            driver_df=0 if driver_df == 1 << 60 else int(driver_df))
 
     # --- execution -------------------------------------------------------
 
@@ -798,15 +851,16 @@ class DeviceIndex:
         # the corpus (or CUBE_MIN_DF, whichever is smaller) prunes badly
         # — full-cube scoring is cheaper than the escalation ladder
         f2_cut = min(CUBE_MIN_DF, max(2 * KAPPA_FLOOR, self.n_docs // 8))
-        f2 = [i for i in live
-              if plans[i].driver_df > f2_cut and plans[i].f2_eligible]
+        f2 = [i for i in live if plans[i].driver_df > f2_cut]
         f1 = [i for i in live if i not in set(f2)]
 
         # wave loop: issue EVERY sub-batch dispatch, fetch ALL outputs
         # in one device_get (one tunnel RTT), then parse; queries whose
-        # pruning check failed go into the (rare) next wave
+        # pruning check failed go into the (rare) next wave with 4x the
+        # selection blocks — terminal at D_cap, where selection is
+        # complete and the check passes by construction
         k_req = min(topk, self.D_cap)
-        f2_exact = False
+        f2_nsel = 2048
         bmax = self._f2_bmax()
         while f1 or f2:
             waves = []
@@ -823,33 +877,40 @@ class DeviceIndex:
             for a in range(0, len(f2), bmax):
                 chunk = f2[a:a + bmax]
                 waves.append(("f2", 0, chunk, self._run_batch_f2(
-                    [plans[i] for i in chunk], k_req, exact=f2_exact)))
+                    [plans[i] for i in chunk], k_req, f2_nsel)))
+            from ..utils.stats import g_stats
+            t_fetch = time.perf_counter()
             outs = jax.device_get([w[3] for w in waves])
+            g_stats.record_ms(
+                "devindex.wave_" + "+".join(sorted({w[0] for w in waves}))
+                + f"_n{len(waves)}",
+                1000 * (time.perf_counter() - t_fetch))
             f1_next: list[int] = []
             f2_next: list[int] = []
             for (kind, kappa, idxs, _), out in zip(waves, outs):
                 k2 = min(k_req, kappa) if kind == "f1" else k_req
                 for row, i in zip(out, idxs):
-                    nm, missed, idx, scores = self._parse_out(row, k2)
+                    k2p = min(k2, f2_nsel, self.D_cap) if kind == "f2" \
+                        else k2
+                    nm, missed, idx, scores = self._parse_out(row, k2p)
                     kth = float(scores[k_req - 1]) if (
-                        k2 >= k_req and scores[k_req - 1] > 0.0) else 0.0
+                        k2p >= k_req and scores[k_req - 1] > 0.0) else 0.0
                     if missed > kth * _TIE_TOL:
                         if kind == "f1" and kappa < self.D_cap:
-                            # κ-grouping covers the driver's whole doc
-                            # set, so this is approx_max_k recall slip —
-                            # widen the rung and rerun
+                            # ≥3 candidate docs shared a block — widen
+                            # the rung and rerun
                             plans[i].driver_df = min(4 * max(
                                 plans[i].driver_df, kappa), self.D_cap)
                             f1_next.append(i)
                             continue
-                        if kind == "f2" and not f2_exact:
+                        if kind == "f2" and f2_nsel < self.D_cap:
                             f2_next.append(i)
                             continue
                     self._emit(results, i, nm, idx, scores)
             if f1_next or f2_next:
                 self.escalations += len(f1_next) + len(f2_next)
             f1, f2 = f1_next, f2_next
-            f2_exact = True
+            f2_nsel = min(f2_nsel * 4, self.D_cap)
         return results
 
     def _parse_out(self, row, k2: int):
@@ -867,12 +928,12 @@ class DeviceIndex:
             scores[keep], nm)
 
     def _kappa_of(self, p: ResidentPlan, topk: int) -> int:
-        """κ group for a plan: candidates ⊆ driver docs, so κ ≥
-        driver_df makes the candidate set complete — ub_missed is 0 by
-        construction and no escalation round ever runs. Three κ rungs
-        keep the compile-variant count tiny."""
+        """κ rung for a plan. Selection is top-2-per-block, so κ wants
+        headroom over the driver's doc count (a block holding ≥3
+        candidate docs loses one and triggers the escalation check);
+        two rungs keep the compile-variant count tiny."""
         need = max(KAPPA_FLOOR, 2 * topk, p.driver_df)
-        for rung in (KAPPA_FLOOR, 8 * KAPPA_FLOOR, 32 * KAPPA_FLOOR):
+        for rung in (8 * KAPPA_FLOOR, 32 * KAPPA_FLOOR):
             if need <= rung:
                 return min(rung, self.D_cap)
         return min(_bucket(need, KAPPA_FLOOR), self.D_cap)
@@ -889,7 +950,7 @@ class DeviceIndex:
         Lsp = _bucket(max([int(p.s_len.max()) if len(p.s_len) else 1
                            for p in plans] + [1]), LSP_FLOOR)
         T = max(len(p.required) for p in plans)
-        B = B_FLOOR if len(plans) <= B_FLOOR else 32  # two B buckets only
+        B = 32  # ONE B bucket — compile variants are ~60s each
 
         def pad_plan(p: ResidentPlan | None):
             if p is None:
@@ -901,7 +962,9 @@ class DeviceIndex:
                         np.ones(Rs, np.int32), np.zeros(Rs, np.uint32),
                         np.ones(Rs, bool),
                         np.full(T, 0.5, np.float32), np.zeros(T, bool),
-                        np.zeros(T, bool), np.zeros(T, bool), np.int32(0))
+                        np.zeros(T, bool), np.zeros(T, bool),
+                        np.zeros(T, bool), np.ones(TABLE_SIZE, bool),
+                        np.int32(0))
             pr = lambda a, n, fill: _pad1(a, n, fill)
             return (pr(p.d_slot, Rd, -1), pr(p.d_group, Rd, 0),
                     pr(p.d_base, Rd, 0), pr(p.d_quota, Rd, 1),
@@ -913,11 +976,13 @@ class DeviceIndex:
                     _pad1(p.freq_weight, T, 0.5),
                     _pad1(p.required, T, False),
                     _pad1(p.negative, T, False),
-                    _pad1(p.scored, T, False), np.int32(p.qlang))
+                    _pad1(p.scored, T, False),
+                    _pad1(p.counts, T, False), p.table,
+                    np.int32(p.qlang))
 
         padded = [pad_plan(p) for p in plans] \
             + [pad_plan(None)] * (B - len(plans))
-        args = [np.stack([p[j] for p in padded]) for j in range(17)]
+        args = [np.stack([p[j] for p in padded]) for j in range(19)]
         # host args ride the (async) dispatch; returned WITHOUT fetching
         # — the caller fetches every wave's output in ONE device_get
         # (each separate blocking fetch costs a full ~100 ms tunnel RTT)
@@ -929,13 +994,14 @@ class DeviceIndex:
             n_positions=self.P, lsp=Lsp, kappa=kappa, k2=k2)
 
     def _run_batch_f2(self, plans: list[ResidentPlan], k2: int,
-                      exact: bool):
+                      n_sel: int):
         Rc = _bucket(max([len(p.c_slot) for p in plans] + [1]), RC_FLOOR)
         Rp = _bucket(max([len(p.p_start) for p in plans] + [1]), RP_FLOOR)
-        Lp = _bucket(max([int(p.p_len.max()) if len(p.p_len) else 1
-                          for p in plans] + [1]), F2_LPOST_FLOOR)
+        maxlen = max([int(p.p_len.max()) if len(p.p_len) else 1
+                      for p in plans] + [1])
+        Lp = F2_LPOST_FLOOR if maxlen <= F2_LPOST_FLOOR else F2_SCATTER_MAX
         T = max(len(p.required) for p in plans)
-        B = F2_B_FLOOR if len(plans) <= F2_B_FLOOR else self._f2_bmax()
+        B = self._f2_bmax()  # ONE B bucket per corpus size
 
         def pad_plan(p: ResidentPlan | None):
             if p is None:
@@ -947,7 +1013,9 @@ class DeviceIndex:
                         np.ones(Rp, np.int32), np.zeros(Rp, np.uint32),
                         np.ones(Rp, bool),
                         np.full(T, 0.5, np.float32), np.zeros(T, bool),
-                        np.zeros(T, bool), np.zeros(T, bool), np.int32(0))
+                        np.zeros(T, bool), np.zeros(T, bool),
+                        np.zeros(T, bool), np.ones(TABLE_SIZE, bool),
+                        np.int32(0))
             pr = lambda a, n, fill: _pad1(a, n, fill)
             return (pr(p.c_slot, Rc, -1), pr(p.c_dslot, Rc, 0),
                     pr(p.c_group, Rc, 0), pr(p.c_base, Rc, 0),
@@ -959,16 +1027,19 @@ class DeviceIndex:
                     _pad1(p.freq_weight, T, 0.5),
                     _pad1(p.required, T, False),
                     _pad1(p.negative, T, False),
-                    _pad1(p.scored, T, False), np.int32(p.qlang))
+                    _pad1(p.scored, T, False),
+                    _pad1(p.counts, T, False), p.table,
+                    np.int32(p.qlang))
 
         padded = [pad_plan(p) for p in plans] \
             + [pad_plan(None)] * (B - len(plans))
-        args = [np.stack([p[j] for p in padded]) for j in range(18)]
+        args = [np.stack([p[j] for p in padded]) for j in range(20)]
         return _full_cube(
             self.d_payload, self.d_pdoc, self.d_pocc, self.d_cube,
             self.d_dense_rsp, self.d_siterank, self.d_doclang,
             self.d_dead, np.int32(self.n_docs), *args,
-            n_positions=self.P, lpost=Lp, k2=k2, exact=exact)
+            n_positions=self.P, lpost=Lp, k2=k2,
+            n_sel=min(n_sel, self.D_cap))
 
 
 @jax.jit
@@ -981,7 +1052,7 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
                d_siterank, d_doclang, d_dead, n_docs_total,
                d_slot, d_group, d_base, d_quota, d_syn,
                s_start, s_len, s_group, s_base, s_quota, s_syn, s_isbase,
-               freqw, required, negative, scored, qlang,
+               freqw, required, negative, scored, counts, table, qlang,
                n_positions: int, lsp: int, kappa: int, k2: int):
     """The fused two-phase kernel, vmapped over the query axis.
 
@@ -999,7 +1070,7 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
 
     def one(d_slot, d_group, d_base, d_quota, d_syn,
             s_start, s_len, s_group, s_base, s_quota, s_syn, s_isbase,
-            freqw, required, negative, scored, qlang):
+            freqw, required, negative, scored, counts, table, qlang):
         T = required.shape[0]
         Rd = d_slot.shape[0]
         Rs = s_start.shape[0]
@@ -1041,13 +1112,14 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
 
         # intersection + admissible min bound
         present = ub > 0.0                                    # [T, D]
-        sc = scored & required
+        sc = counts
         ubw = ub * (freqw * freqw)[:, None]
         req_ok = jnp.all(jnp.where(required[:, None], present, True),
                          axis=0)
         neg_ok = ~jnp.any(jnp.where(negative[:, None], present, False),
                           axis=0)
-        alive = req_ok & neg_ok & (jnp.arange(D) < n_docs_total)
+        alive = (req_ok & neg_ok & presence_table_ok(present, table)
+                 & (jnp.arange(D) < n_docs_total))
         m1 = present & sc[:, None]
         min_single_ub = jnp.min(jnp.where(m1, ubw, big), axis=0)
         min_pair_ub = jnp.full((D,), big)
@@ -1108,7 +1180,8 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
                           axis=0)
         neg_ok2 = ~jnp.any(jnp.where(negative[:, None], present2, False),
                            axis=0)
-        match2 = req_ok2 & neg_ok2 & (cval > 0.0) & (min_sc < big)
+        match2 = (req_ok2 & neg_ok2 & presence_table_ok(present2, table)
+                  & (cval > 0.0) & (min_sc < big))
         final = jnp.where(
             match2,
             min_sc * final_multipliers(d_siterank[cand], d_doclang[cand],
@@ -1127,16 +1200,16 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
     return jax.vmap(one)(d_slot, d_group, d_base, d_quota, d_syn,
                          s_start, s_len, s_group, s_base, s_quota, s_syn,
                          s_isbase, freqw, required, negative, scored,
-                         qlang)
+                         counts, table, qlang)
 
 
-@partial(jax.jit, static_argnames=("n_positions", "lpost", "k2", "exact"))
+@partial(jax.jit, static_argnames=("n_positions", "lpost", "k2", "n_sel"))
 def _full_cube(d_payload, d_pdoc, d_pocc, d_cube, d_dense_rsp,
                d_siterank, d_doclang, d_dead, n_docs_total,
                c_slot, c_dslot, c_group, c_base, c_quota, c_syn,
                p_start, p_len, p_group, p_base, p_quota, p_syn, p_isbase,
-               freqw, required, negative, scored, qlang,
-               n_positions: int, lpost: int, k2: int, exact: bool):
+               freqw, required, negative, scored, counts, table, qlang,
+               n_positions: int, lpost: int, k2: int, n_sel: int):
     """Full-corpus exact kernel (F2) for corpus-wide drivers.
 
     Builds the [T, P, D] position cube over the WHOLE doc axis — the
@@ -1154,7 +1227,7 @@ def _full_cube(d_payload, d_pdoc, d_pocc, d_cube, d_dense_rsp,
 
     def one(c_slot, c_dslot, c_group, c_base, c_quota, c_syn,
             p_start, p_len, p_group, p_base, p_quota, p_syn, p_isbase,
-            freqw, required, negative, scored, qlang):
+            freqw, required, negative, scored, counts, table, qlang):
         T = required.shape[0]
         Rc = c_slot.shape[0]
         Rp = p_start.shape[0]
@@ -1209,26 +1282,23 @@ def _full_cube(d_payload, d_pdoc, d_pocc, d_cube, d_dense_rsp,
         pv = pv.reshape(-1).at[tgt.ravel()].set(
             ok.ravel(), mode="drop").reshape(T, P, D)
 
-        sc = scored & required
-        min_sc, present = min_scores(cube, pv, freqw, sc)
+        min_sc, present = min_scores(cube, pv, freqw, counts)
         req_ok = jnp.all(jnp.where(required[:, None], present, True),
                          axis=0)
         neg_ok = ~jnp.any(jnp.where(negative[:, None], present, False),
                           axis=0)
-        match = (req_ok & neg_ok & (jnp.arange(D) < n_docs_total)
-                 & (min_sc < big))
+        match = (req_ok & neg_ok & presence_table_ok(present, table)
+                 & (jnp.arange(D) < n_docs_total) & (min_sc < big))
         final = jnp.where(
             match, min_sc * final_multipliers(d_siterank, d_doclang,
                                               qlang), 0.0)
         nm = jnp.sum(match)
-        if exact:
-            ts, ti = jax.lax.top_k(final, k2)
-            missed = jnp.float32(0.0)
-        else:
-            ts, ti = jax.lax.approx_max_k(final, k2,
-                                          recall_target=0.98)
-            selmask = jnp.zeros((D,), bool).at[ti].set(True)
-            missed = jnp.max(jnp.where(selmask, 0.0, final))
+        # block-winners then a cheap exact top-k over the winners;
+        # escalation reruns with 4x the blocks, terminal at n_sel == D
+        # where every doc is selected and missed is exactly 0
+        w_vals, w_idx, missed = _block_top2(final, min(n_sel, D))
+        ts, tl = jax.lax.top_k(w_vals, min(k2, min(n_sel, D)))
+        ti = w_idx[tl]
         return jnp.concatenate([
             jnp.atleast_1d(nm.astype(jnp.uint32)),
             jax.lax.bitcast_convert_type(jnp.atleast_1d(missed),
@@ -1240,4 +1310,4 @@ def _full_cube(d_payload, d_pdoc, d_pocc, d_cube, d_dense_rsp,
     return jax.vmap(one)(c_slot, c_dslot, c_group, c_base, c_quota,
                          c_syn, p_start, p_len, p_group, p_base, p_quota,
                          p_syn, p_isbase, freqw, required, negative,
-                         scored, qlang)
+                         scored, counts, table, qlang)

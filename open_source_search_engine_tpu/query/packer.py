@@ -78,6 +78,20 @@ L_FLOOR = 512    # postings per group
 D_FLOOR = 256    # candidate docs
 
 
+#: truth-table bucket: boolean tables pad to this many entries (2^10 =
+#: MAX_BOOL_TERMS); non-boolean queries carry the all-true table (the
+#: required/negative masks own match semantics there)
+TABLE_SIZE = 1 << 10
+
+
+def pad_table(table: np.ndarray | None) -> np.ndarray:
+    out = np.ones(TABLE_SIZE, bool)
+    if table is not None:
+        out[:] = False
+        out[: len(table)] = table
+    return out
+
+
 @dataclass
 class PackedQuery:
     """Device-ready query: everything the scorer jit consumes.
@@ -97,6 +111,8 @@ class PackedQuery:
     required: np.ndarray      # bool [T]
     negative: np.ndarray      # bool [T]
     scored: np.ndarray        # bool [T]
+    counts: np.ndarray        # bool [T] groups entering the min-score
+    table: np.ndarray         # bool [TABLE_SIZE] boolean truth table
     # per candidate doc
     cand_docids: np.ndarray   # uint64 [D] (actual candidates; D_pad ≥ D)
     siterank: np.ndarray      # int32 [D_pad]
@@ -120,6 +136,11 @@ class GroupList:
     langid: np.ndarray     # int32, parallel
     sub: np.ndarray        # int32, parallel: originating sublist index
     n_subs: int = 1        # sublist count (sets the per-sublist quota)
+    #: max distinct-doc count over the group's sublists — THE group df
+    #: (devindex._df_of uses the same definition, so freq weights agree
+    #: across paths; a synonym sublist must not inflate the main term's
+    #: document frequency)
+    group_df: int = 0
 
 
 def fetch_group_lists(coll: Collection, plan: QueryPlan) -> list[GroupList]:
@@ -128,6 +149,7 @@ def fetch_group_lists(coll: Collection, plan: QueryPlan) -> list[GroupList]:
     for g in plan.groups:
         cols = {"docids": [], "payload": [], "siterank": [], "langid": [],
                 "sub": []}
+        sub_dfs = [0]
         for s_i, sub in enumerate(g.sublists):
             batch = coll.posdb.get_list(posdb.start_key(sub.termid),
                                         posdb.end_key(sub.termid))
@@ -136,6 +158,10 @@ def fetch_group_lists(coll: Collection, plan: QueryPlan) -> list[GroupList]:
             f = posdb.unpack(batch.keys)
             payload = pack_payload(
                 f, syn=1 if sub.kind == SUB_SYNONYM else 0)
+            # postings arrive key-sorted (docid ascending within the
+            # term), so the distinct-doc count is a boundary count
+            d_ = f["docid"]
+            sub_dfs.append(int((d_[1:] != d_[:-1]).sum()) + 1)
             cols["docids"].append(f["docid"])
             cols["payload"].append(payload)
             cols["siterank"].append(f["siterank"].astype(np.int32))
@@ -153,7 +179,8 @@ def fetch_group_lists(coll: Collection, plan: QueryPlan) -> list[GroupList]:
                 siterank=np.concatenate(cols["siterank"])[order],
                 langid=np.concatenate(cols["langid"])[order],
                 sub=np.concatenate(cols["sub"])[order],
-                n_subs=max(len(g.sublists), 1)))
+                n_subs=max(len(g.sublists), 1),
+                group_df=max(sub_dfs)))
         else:
             out.append(GroupList(
                 docids=np.empty(0, np.uint64),
@@ -189,13 +216,23 @@ class PreparedQuery:
 
 
 def group_flags(plan: QueryPlan, T: int):
-    """(required, negative, scored) bool arrays padded to the T bucket —
-    pure functions of the plan, shared by every shard/pass."""
+    """(required, negative, scored, counts) bool arrays padded to the T
+    bucket — pure functions of the plan, shared by every shard/pass.
+
+    ``counts`` marks the groups whose single/pair scores enter the
+    min-score: scored∧required normally, every scored group under a
+    boolean plan (required-ness is meaningless under OR — the truth
+    table owns matching; scoring is the min over PRESENT scored
+    groups, reference boolean behavior)."""
+    boolean = plan.bool_table is not None
     return (
         _pad1(np.array([g.required and not g.negative
                         for g in plan.groups]), T, False),
         _pad1(np.array([g.negative for g in plan.groups]), T, False),
         _pad1(np.array([g.scored and not g.negative
+                        for g in plan.groups]), T, False),
+        _pad1(np.array([g.scored and not g.negative
+                        and (boolean or g.required)
                         for g in plan.groups]), T, False),
     )
 
@@ -212,15 +249,31 @@ def prepare_query(coll: Collection, plan: QueryPlan) -> PreparedQuery:
     req = [i for i, g in enumerate(plan.groups)
            if g.required and not g.negative]
 
-    uniques = {i: np.unique(lists[i].docids) for i in req}
-    # per-group unique-doc counts for term-frequency stats (scored ⊆
-    # required, so required groups' counts are the ones that matter)
+    # candidate sets: required groups only in conjunctive mode; every
+    # group under a boolean plan (the union is the candidate space)
+    need_uniq = (range(len(lists)) if plan.bool_table is not None
+                 else [i for i in req])
+    uniques = {i: np.unique(lists[i].docids) for i in need_uniq}
     unique_counts = np.array(
-        [len(uniques[i]) if i in uniques else
-         len(np.unique(lists[i].docids)) if len(lists[i].docids) else 0
-         for i in range(len(lists))], dtype=np.int64)
+        [lists[i].group_df for i in range(len(lists))], dtype=np.int64)
     nd = max(coll.num_docs, 1)
     freqw = weights.term_freq_weight(unique_counts, nd)
+
+    if plan.bool_table is not None:
+        # boolean plan: candidates = union of every group's docids (any
+        # satisfying doc has ≥1 present group — the compiler rejects
+        # tables that match the empty presence set); the truth table
+        # decides matching on device
+        cand = (np.unique(np.concatenate(
+            [uniques[i] for i in range(len(lists))]))
+            if lists and any(len(u) for u in uniques.values())
+            else np.empty(0, np.uint64))
+        driver = (max(range(len(lists)), key=lambda i: len(uniques[i]))
+                  if lists else -1)
+        return PreparedQuery(plan=plan, lists=lists, cand=cand,
+                             driver=driver if len(cand) else -1,
+                             freq_weight=freqw,
+                             unique_counts=unique_counts)
 
     if not req or any(not len(uniques[i]) for i in req):
         return PreparedQuery(plan=plan, lists=lists,
@@ -252,7 +305,7 @@ def pack_pass(prep: PreparedQuery, doc_offset: int = 0,
         cand = prep.cand[doc_offset:] if doc_offset else prep.cand
     if not len(cand):
         return None
-    required, negative, scored = group_flags(
+    required, negative, scored, counts = group_flags(
         plan, _bucket(len(plan.groups), T_FLOOR))
 
     T = _bucket(len(plan.groups), T_FLOOR)
@@ -261,7 +314,7 @@ def pack_pass(prep: PreparedQuery, doc_offset: int = 0,
 
     per_group = []
     max_kept = 1
-    for gl in lists:
+    for g_i, gl in enumerate(lists):
         if not len(gl.docids):
             per_group.append((np.empty(0, np.int32), np.empty(0, np.uint32),
                               np.empty(0, np.int32)))
@@ -272,21 +325,24 @@ def pack_pass(prep: PreparedQuery, doc_offset: int = 0,
         didx = pos_in_cand_c[hit].astype(np.int32)
         payload = gl.payload[hit]
         sub = gl.sub[hit]
-        # per-sublist slot quota within each doc: sublist s owns slots
-        # [s·quota, (s+1)·quota) so a spammy word can never starve its
-        # bigram/synonym siblings out of the position cube (the resident
-        # kernel uses the identical base+rank scheme — parity by
-        # construction). (doc, sublist) runs are contiguous: stable
-        # docid sort keeps sublist-major order within a doc.
+        # per-sublist slot quotas within each doc (TermGroup.slot_plan:
+        # the primary word keeps ≥ half the budget, variants split the
+        # rest) so a spammy variant can never starve the primary out of
+        # the position cube. The resident kernel uses the identical
+        # base+quota scheme — parity by construction. (doc, sublist)
+        # runs are contiguous: stable docid sort keeps sublist-major
+        # order within a doc.
         if len(didx):
-            quota = max(max_positions // gl.n_subs, 1)
+            sp = plan.groups[g_i].slot_plan(max_positions)
+            bases = np.array([b for b, _ in sp], np.int32)
+            quotas = np.array([q for _, q in sp], np.int32)
             n = len(didx)
             boundary = np.ones(n, bool)
             boundary[1:] = (didx[1:] != didx[:-1]) | (sub[1:] != sub[:-1])
             idx = np.arange(n)
             rank = idx - np.maximum.accumulate(np.where(boundary, idx, 0))
-            slot = (sub * quota + rank).astype(np.int32)
-            keep = (rank < quota) & (slot < max_positions)
+            slot = (bases[sub] + rank).astype(np.int32)
+            keep = (rank < quotas[sub]) & (slot < max_positions)
             didx, payload, slot = didx[keep], payload[keep], slot[keep]
             max_kept = max(max_kept, len(didx))
         else:
@@ -305,19 +361,33 @@ def pack_pass(prep: PreparedQuery, doc_offset: int = 0,
         slot[t, :n] = sl
         valid[t, :n] = True
 
-    # per-candidate-doc siterank/langid from the driver group's first
-    # posting (reference: getSiteRank(miniMergedList[0]), Posdb.cpp:6989)
+    # per-candidate-doc siterank/langid from the first posting of a
+    # group containing the doc (reference: getSiteRank(miniMergedList[0])
+    # Posdb.cpp:6989); under a boolean plan no single group covers every
+    # candidate, so walk groups until each doc is filled
     siterank = np.zeros(D_pad, dtype=np.int32)
     doclang = np.zeros(D_pad, dtype=np.int32)
-    gl = lists[prep.driver]
-    first = np.searchsorted(gl.docids, cand)
-    siterank[:D] = gl.siterank[np.clip(first, 0, len(gl.docids) - 1)]
-    doclang[:D] = gl.langid[np.clip(first, 0, len(gl.docids) - 1)]
+    filled = np.zeros(D, dtype=bool)
+    order = [prep.driver] + [i for i in range(len(lists))
+                             if i != prep.driver]
+    for g_i in order:
+        gl = lists[g_i]
+        if not len(gl.docids) or filled.all():
+            continue
+        first = np.clip(np.searchsorted(gl.docids, cand), 0,
+                        len(gl.docids) - 1)
+        hit = (gl.docids[first] == cand) & ~filled
+        siterank[:D][hit] = gl.siterank[first[hit]]
+        doclang[:D][hit] = gl.langid[first[hit]]
+        filled |= hit
+        if plan.bool_table is None:
+            break  # driver covers every candidate in conjunctive mode
 
     return PackedQuery(
         doc_idx=doc_idx, payload=payload, slot=slot, valid=valid,
         freq_weight=_pad1(prep.freq_weight, T, 0.5),
         required=required, negative=negative, scored=scored,
+        counts=counts, table=pad_table(plan.bool_table),
         cand_docids=cand,
         siterank=siterank, doclang=doclang,
         n_docs=D, qlang=plan.lang)

@@ -50,7 +50,7 @@ import numpy as np
 
 from ..index.posdb import HASHGROUP_END, HASHGROUP_INLINKTEXT
 from . import weights
-from .packer import MAX_POSITIONS, PackedQuery
+from .packer import MAX_POSITIONS, TABLE_SIZE, PackedQuery
 
 QDIST = 2.0  # default query-distance (Posdb.cpp:6886)
 
@@ -63,6 +63,22 @@ def _decode(payload: jnp.ndarray):
     spam = ((payload >> jnp.uint32(27)) & jnp.uint32(0xF)).astype(jnp.int32)
     syn = ((payload >> jnp.uint32(31)) & jnp.uint32(1)).astype(jnp.int32)
     return wordpos, hg, den, spam, syn
+
+
+def _tiny_lookup(table: np.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Tiny-table lookup, backend-tuned.
+
+    On TPU a gather from an 11-entry table over a [T, P, D] index array
+    lowers to scalar gathers (~60 Melem/s — measured to dominate the
+    whole scoring kernel), so it becomes a trace-time-unrolled select
+    chain that fuses into the surrounding elementwise work. On CPU the
+    chain is the slow form and the gather is free — keep the gather."""
+    if jax.default_backend() == "cpu":
+        return jnp.asarray(table, jnp.float32)[idx]
+    out = jnp.full(idx.shape, float(table[0]), jnp.float32)
+    for v in range(1, len(table)):
+        out = jnp.where(idx == v, jnp.float32(table[v]), out)
+    return out
 
 
 def scatter_cube(doc_idx, payload, slot, valid, n_docs_padded: int,
@@ -97,11 +113,17 @@ def position_weights(cube, pvalid):
     BASE_SCORE (singles square the weight, pairs take one factor per
     side — Posdb.cpp:3118)."""
     wordpos, hg, den, spam, syn = _decode(cube)
-    hgw = jnp.asarray(weights.HASH_GROUP_WEIGHTS)[hg]
-    denw = jnp.asarray(weights.DENSITY_WEIGHTS)[den]
+    hgw = _tiny_lookup(weights.HASH_GROUP_WEIGHTS, hg)
+    # density weight in closed form (min(0.35·1.03445^rank, 1),
+    # Posdb.cpp:1117-1125) — cheaper than any lookup
+    denw = jnp.minimum(
+        jnp.float32(0.35) * jnp.exp(den.astype(jnp.float32)
+                                    * jnp.float32(np.log(1.03445))),
+        1.0)
+    spamf = spam.astype(jnp.float32)
     spamw = jnp.where(hg == HASHGROUP_INLINKTEXT,
-                      jnp.asarray(weights.LINKER_WEIGHTS)[spam],
-                      jnp.asarray(weights.WORD_SPAM_WEIGHTS)[spam])
+                      jnp.sqrt(1.0 + spamf),        # Posdb.cpp:1136
+                      (spamf + 1.0) * jnp.float32(1.0 / 16.0))
     synw = jnp.where(syn == 1, weights.SYNONYM_WEIGHT, 1.0)
     posw = hgw * denw * spamw * synw                       # [T, P, D]
     posscore = weights.BASE_SCORE * posw * posw * pvalid   # squared weights
@@ -121,7 +143,8 @@ def min_scores(cube, pvalid, freq_weight, single_counts):
     # ---- single-term scores (getSingleTermScore) ----
     # dedup by mapped hashgroup: one best position per collapsed group,
     # except INLINKTEXT where every occurrence competes individually
-    mhg = jnp.asarray(weights.MAPPED_HASHGROUP)[hg]        # [T, P, D]
+    mhg = _tiny_lookup(weights.MAPPED_HASHGROUP, hg
+                       ).astype(jnp.int32)                 # [T, P, D]
     is_inlink = hg == HASHGROUP_INLINKTEXT
     grp_max = [
         jnp.max(jnp.where(mhg == g, posscore, 0.0), axis=1)
@@ -139,7 +162,7 @@ def min_scores(cube, pvalid, freq_weight, single_counts):
     min_single = jnp.min(jnp.where(s_mask, single, big), axis=0)    # [D]
 
     # ---- pair scores: exact max over P×P per (i, j) ----
-    in_body = jnp.asarray(weights.IN_BODY)[hg]             # [T, P, D]
+    in_body = _tiny_lookup(weights.IN_BODY, hg) > 0.5      # [T, P, D]
     min_pair = jnp.full((D,), big)
     any_pair = jnp.zeros((D,), jnp.bool_)
     for i in range(T):
@@ -186,27 +209,37 @@ def final_multipliers(siterank, doclang, qlang):
             + 1.0) * lang_mult
 
 
+def presence_table_ok(present, table):
+    """Boolean-expression gate: pack per-doc presence bits and index the
+    query's truth table (Query.h:266 semantics — non-boolean queries
+    carry the all-true table and gate purely on required/negative)."""
+    T, D = present.shape
+    powers = (1 << jnp.arange(T, dtype=jnp.int32))[:, None]
+    idx = jnp.sum(present.astype(jnp.int32) * powers, axis=0)
+    return table[jnp.clip(idx, 0, TABLE_SIZE - 1)]
+
+
 def score_cube(cube, pvalid, freq_weight, required, negative, scored,
-               siterank, doclang, qlang, n_docs, topk: int = 64):
+               counts, table, siterank, doclang, qlang, n_docs,
+               topk: int = 64):
     """Score the dense position cube — the docIdLoop replacement.
 
     Shapes: cube/pvalid [T, P, D] (doc axis minor);
-    freq_weight/required/negative/scored [T]; siterank/doclang [D];
-    qlang/n_docs scalars. Returns (match count, top scores [k], top doc
-    indices [k]).
+    freq_weight/required/negative/scored/counts [T]; table [TABLE_SIZE];
+    siterank/doclang [D]; qlang/n_docs scalars. Returns (match count,
+    top scores [k], top doc indices [k]).
     """
     T, P, D = cube.shape
     big = jnp.float32(9.99e8)
-    single_counts = scored & required  # scoring skips negatives/filters
-    min_score, present = min_scores(cube, pvalid, freq_weight,
-                                    single_counts)
+    min_score, present = min_scores(cube, pvalid, freq_weight, counts)
 
     # ---- match mask: every required group present, no negative present,
-    #      inside the real (unpadded) candidate range ----
+    #      truth table satisfied, inside the real candidate range ----
     req_ok = jnp.all(jnp.where(required[:, None], present, True), axis=0)
     neg_ok = ~jnp.any(jnp.where(negative[:, None], present, False), axis=0)
     in_range = jnp.arange(D) < n_docs
-    match = req_ok & neg_ok & in_range & (min_score < big)
+    match = (req_ok & neg_ok & presence_table_ok(present, table)
+             & in_range & (min_score < big))
 
     final = min_score * final_multipliers(siterank, doclang, qlang)
     final = jnp.where(match, final, 0.0)
@@ -218,7 +251,8 @@ def score_cube(cube, pvalid, freq_weight, required, negative, scored,
 
 
 def score_core(doc_idx, payload, slot, valid, freq_weight, required,
-               negative, scored, siterank, doclang, qlang, n_docs,
+               negative, scored, counts, table, siterank, doclang,
+               qlang, n_docs,
                n_positions: int = MAX_POSITIONS, topk: int = 64):
     """Host-packed entry: scatter rows (1 row = 1 group) then score.
     Pure traced function — called under plain jit for the single-shard
@@ -226,7 +260,8 @@ def score_core(doc_idx, payload, slot, valid, freq_weight, required,
     cube, pvalid = scatter_cube(doc_idx, payload, slot, valid,
                                 siterank.shape[0], n_positions)
     return score_cube(cube, pvalid, freq_weight, required, negative,
-                      scored, siterank, doclang, qlang, n_docs, topk=topk)
+                      scored, counts, table, siterank, doclang, qlang,
+                      n_docs, topk=topk)
 
 
 score_and_topk = jax.jit(score_core, static_argnames=("n_positions", "topk"))
@@ -257,7 +292,8 @@ def run_query(pq: PackedQuery, topk: int = 64):
     # tunnel RPC overhead; a single list transfer is ~10× cheaper
     dev = jax.device_put([
         pq.doc_idx, pq.payload, pq.slot, pq.valid, pq.freq_weight,
-        pq.required, pq.negative, pq.scored, pq.siterank, pq.doclang,
+        pq.required, pq.negative, pq.scored, pq.counts, pq.table,
+        pq.siterank, pq.doclang,
         np.int32(pq.qlang), np.int32(pq.n_docs)])
     out = np.asarray(_score_packed(
         *dev, n_positions=MAX_POSITIONS, topk=topk))

@@ -25,17 +25,22 @@ class TestCompiler:
         plan = compiler.compile_query("street light")
         assert len(plan.groups) == 2
         assert all(g.required and g.scored for g in plan.groups)
-        # left word carries the bigram sublist
-        assert len(plan.groups[0].sublists) == 2
-        assert plan.groups[0].sublists[1].kind == compiler.SUB_BIGRAM
-        assert len(plan.groups[1].sublists) == 1
+        # left word carries the bigram sublist (+ synonym conjugates)
+        kinds0 = [sl.kind for sl in plan.groups[0].sublists]
+        assert kinds0[0] == compiler.SUB_ORIGINAL
+        assert compiler.SUB_BIGRAM in kinds0
+        kinds1 = [sl.kind for sl in plan.groups[1].sublists]
+        assert compiler.SUB_BIGRAM not in kinds1
+        assert compiler.SUB_SYNONYM in kinds0  # streets etc.
 
     def test_negative(self):
         plan = compiler.compile_query("apple -banana")
         assert plan.groups[0].negative is False
         assert plan.groups[1].negative is True
-        # no bigram across a negative term
-        assert len(plan.groups[0].sublists) == 1
+        # no bigram across a negative term; negatives stay literal
+        assert not any(sl.kind == compiler.SUB_BIGRAM
+                       for sl in plan.groups[0].sublists)
+        assert len(plan.groups[1].sublists) == 1
 
     def test_site_filter(self):
         plan = compiler.compile_query("news site:example.com")
@@ -218,7 +223,8 @@ class TestScoringSemantics:
             doc_idx=doc_idx, payload=payload, slot=slot, valid=valid,
             freq_weight=np.array(freqw or [0.5] * T, np.float32),
             required=np.ones(T, bool), negative=np.zeros(T, bool),
-            scored=np.ones(T, bool),
+            scored=np.ones(T, bool), counts=np.ones(T, bool),
+            table=packer.pad_table(None),
             cand_docids=np.array([1234], np.uint64),
             siterank=np.full(1, siterank, np.int32),
             doclang=np.zeros(1, np.int32), n_docs=1, qlang=0)

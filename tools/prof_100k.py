@@ -1,0 +1,86 @@
+"""Profile F1/F2 kernels against the persistent 100k corpus."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import bench
+import jax
+
+
+def timed(label, fn, n=3):
+    t0 = time.perf_counter()
+    out = fn(0)
+    jax.block_until_ready(out)
+    warm = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for i in range(1, n + 1):
+        out = fn(i)
+    jax.block_until_ready(out)
+    el = (time.perf_counter() - t0) / n
+    print(f"{label}: {1000*el:.0f} ms (first {warm:.1f}s)", flush=True)
+    return el
+
+
+def main():
+    from open_source_search_engine_tpu.index.collection import Collection
+    from open_source_search_engine_tpu.query import engine
+    from open_source_search_engine_tpu.query.compiler import compile_query
+    import open_source_search_engine_tpu.query.devindex as dv
+
+    coll = Collection("bench", "/root/bench_corpus")
+    t0 = time.perf_counter()
+    di = engine.get_device_index(coll)
+    print(f"device build: {time.perf_counter()-t0:.0f}s  D_cap={di.D_cap} "
+          f"Vc={di.Vc} dense={len(di.dense_slot_of)} cube={len(di.cube_slot_of)}",
+          flush=True)
+
+    qs = bench._make_queries(2000, seed=5)
+    plans = {}
+    f2_cut = min(dv.CUBE_MIN_DF, max(2 * dv.KAPPA_FLOOR, di.n_docs // 8))
+    f1_qs, f2_qs = [], []
+    for q in qs:
+        p = di.plan(compile_query(q, 0))
+        if not p.matchable:
+            continue
+        if p.driver_df > f2_cut:
+            f2_qs.append(p)
+        else:
+            f1_qs.append(p)
+    print(f"routing: {len(f1_qs)} f1 / {len(f2_qs)} f2 of {len(qs)}", flush=True)
+    k1 = {}
+    for p in f1_qs:
+        k1.setdefault(di._kappa_of(p, 64), []).append(p)
+    print("f1 kappa distribution:", {k: len(v) for k, v in k1.items()}, flush=True)
+
+    # --- F1 batches per kappa rung (warmed, unique plans per iter) ---
+    for kappa, ps in sorted(k1.items()):
+        if len(ps) < 4 * 32:
+            ps = (ps * (4 * 32 // max(len(ps), 1) + 1))
+        timed(f"F1 batch32 k={kappa}",
+              lambda i, ps=ps, kappa=kappa: di._run_batch(
+                  ps[32*i:32*i+32], kappa, min(64, kappa)))
+
+    # --- F2 chunks ---
+    bmax = di._f2_bmax()
+    print(f"f2 bmax={bmax}", flush=True)
+    if f2_qs:
+        ps = f2_qs * (4 * bmax // max(len(f2_qs), 1) + 1)
+        timed(f"F2 chunk B={bmax}",
+              lambda i, ps=ps: di._run_batch_f2(ps[bmax*i:bmax*i+bmax], 64,
+                                                exact=False))
+        timed("F2 chunk B=4",
+              lambda i, ps=ps: di._run_batch_f2(ps[4*i:4*i+4], 64,
+                                                exact=False))
+
+    # --- end-to-end search_batch ---
+    timed("search_batch 32 (raw)", lambda i: [
+        np.concatenate([r[1] for r in di.search_batch(qs[800+32*i:832+32*i],
+                                                      topk=64)])], n=3)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,200 @@
+"""Stage-ablation of the F2 full-cube kernel at 100k docs."""
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import bench
+from open_source_search_engine_tpu.index.collection import Collection
+from open_source_search_engine_tpu.query import engine, weights
+from open_source_search_engine_tpu.query.compiler import compile_query
+from open_source_search_engine_tpu.query.scorer import (
+    QDIST, final_multipliers, position_weights, presence_table_ok)
+from open_source_search_engine_tpu.index.posdb import (
+    HASHGROUP_END, HASHGROUP_INLINKTEXT)
+import open_source_search_engine_tpu.query.devindex as dv
+
+
+@partial(jax.jit, static_argnames=("n_positions", "lpost", "k2", "stage"))
+def f2_staged(d_payload, d_pdoc, d_pocc, d_cube, d_dense_rsp,
+              d_siterank, d_doclang, d_dead, n_docs_total,
+              c_slot, c_dslot, c_group, c_base, c_quota, c_syn,
+              p_start, p_len, p_group, p_base, p_quota, p_syn, p_isbase,
+              freqw, required, negative, scored, counts, table, qlang,
+              n_positions: int, lpost: int, k2: int, stage: int,
+              exact: bool = False):
+    D = d_dead.shape[0]
+    N = d_payload.shape[0]
+    P = n_positions
+    VcPD = d_cube.shape[0]
+    big = jnp.float32(9.99e8)
+
+    def one(c_slot, c_dslot, c_group, c_base, c_quota, c_syn,
+            p_start, p_len, p_group, p_base, p_quota, p_syn, p_isbase,
+            freqw, required, negative, scored, counts, table, qlang):
+        T = required.shape[0]
+        Rc = c_slot.shape[0]
+        Rp = p_start.shape[0]
+        t_ax = jnp.arange(T)
+        live = ~d_dead
+        p_ax = jnp.arange(P, dtype=jnp.int32)[:, None]
+        cube = jnp.zeros((T, P, D), jnp.uint32)
+        pv = jnp.zeros((T, P, D), bool)
+        V = d_dense_rsp.shape[0] // D
+        for r in range(Rc):
+            gate = c_slot[r] >= 0
+            row = jax.lax.dynamic_slice(
+                d_cube, (jnp.clip(c_slot[r], 0, VcPD // (P * D) - 1)
+                         * P * D,), (P * D,)).reshape(P, D)
+            cnt = (jax.lax.dynamic_slice(
+                d_dense_rsp, (jnp.clip(c_dslot[r], 0, V - 1) * D,),
+                (D,)) & 31)
+            q = p_ax[:, 0] - c_base[r]
+            row = jnp.take(row, jnp.clip(q, 0, P - 1), axis=0)
+            pvr = ((q[:, None] >= 0)
+                   & (q[:, None] < jnp.minimum(cnt, c_quota[r])[None, :])
+                   & live[None, :] & gate)
+            val = row | (c_syn[r].astype(jnp.uint32) << jnp.uint32(31))
+            gmask = (c_group[r] == t_ax)[:, None, None]
+            cube = cube + jnp.where(pvr, val, jnp.uint32(0))[None] \
+                * gmask.astype(jnp.uint32)
+            pv = pv | (pvr[None] & gmask)
+        if stage == 0:
+            return cube.sum(axis=(0, 1))[:2 * k2].astype(jnp.float32)
+        lane = jnp.arange(lpost, dtype=jnp.int32)
+        idx = p_start[:, None] + lane[None, :]
+        m = lane[None, :] < p_len[:, None]
+        idxc = jnp.clip(idx, 0, N - 1)
+        doc = d_pdoc[idxc]
+        occ = d_pocc[idxc].astype(jnp.int32)
+        pay = (d_payload[idxc]
+               | (p_syn[:, None].astype(jnp.uint32) << jnp.uint32(31)))
+        dead_l = d_dead[jnp.clip(doc, 0, D - 1)]
+        ok = (m & (occ < p_quota[:, None]) & ~(dead_l & p_isbase[:, None]))
+        slot = p_base[:, None] + occ
+        tgt = jnp.where(ok, (p_group[:, None] * P + slot) * D + doc,
+                        T * P * D)
+        cube = cube.reshape(-1).at[tgt.ravel()].add(
+            jnp.where(ok, pay, jnp.uint32(0)).ravel(), mode="drop"
+        ).reshape(T, P, D)
+        pv = pv.reshape(-1).at[tgt.ravel()].set(
+            ok.ravel(), mode="drop").reshape(T, P, D)
+        if stage == 1:
+            return cube.sum(axis=(0, 1))[:2 * k2].astype(jnp.float32)
+
+        # min_scores inline, staged
+        posscore, posw, wordpos, hg = position_weights(cube, pv)
+        present = jnp.any(pv, axis=1)
+        if stage == 2:
+            return posscore.sum(axis=(0, 1))[:2 * k2]
+        mhg = jnp.asarray(weights.MAPPED_HASHGROUP)[hg]
+        is_inlink = hg == HASHGROUP_INLINKTEXT
+        grp_max = [
+            jnp.max(jnp.where(mhg == g, posscore, 0.0), axis=1)
+            if g != HASHGROUP_INLINKTEXT else jnp.zeros((T, D),
+                                                        posscore.dtype)
+            for g in range(HASHGROUP_END)]
+        inlink_scores = jnp.where(is_inlink, posscore, 0.0)
+        cand = jnp.concatenate(
+            [jnp.stack(grp_max, axis=1), inlink_scores], axis=1)
+        if stage == 3:
+            return cand.sum(axis=(0, 1))[:2 * k2]
+        k10 = min(weights.MAX_TOP, cand.shape[1])
+        top_sum = jnp.sum(jnp.sort(cand, axis=1)[:, -k10:, :], axis=1)
+        single = top_sum * (freqw * freqw)[:, None]
+        if stage == 4:
+            return single.sum(axis=0)[:2 * k2]
+        s_mask = present & counts[:, None]
+        min_single = jnp.min(jnp.where(s_mask, single, big), axis=0)
+        in_body = jnp.asarray(weights.IN_BODY)[hg]
+        min_pair = jnp.full((D,), big)
+        any_pair = jnp.zeros((D,), jnp.bool_)
+        for i in range(T):
+            for j in range(i + 1, T):
+                delta = (wordpos[j][None, :, :]
+                         - wordpos[i][:, None, :]).astype(jnp.float32)
+                d_plain = jnp.maximum(jnp.abs(delta), 2.0)
+                body_i = in_body[i][:, None, :]
+                body_j = in_body[j][None, :, :]
+                mixed = body_i != body_j
+                both_nb = (~body_i) & (~body_j)
+                d_base = jnp.where(
+                    both_nb & (d_plain > weights.NONBODY_DIST_CAP),
+                    float(weights.FIXED_DISTANCE), d_plain)
+                d_adj = (jnp.where(d_base >= QDIST, d_base - QDIST,
+                                   d_base) + (delta < 0))
+                dist = jnp.where(mixed, float(weights.FIXED_DISTANCE),
+                                 d_adj)
+                pvp = (pv[i][:, None, :] & pv[j][None, :, :])
+                ps = (weights.BASE_SCORE
+                      * posw[i][:, None, :] * posw[j][None, :, :]
+                      / (dist + 1.0)) * pvp
+                best = jnp.max(ps, axis=(0, 1))
+                wts = best * freqw[i] * freqw[j]
+                pair_ok = (present[i] & present[j]
+                           & counts[i] & counts[j])
+                min_pair = jnp.where(pair_ok,
+                                     jnp.minimum(min_pair, wts), min_pair)
+                any_pair = any_pair | pair_ok
+        if stage == 5:
+            return min_pair[:2 * k2]
+        min_sc = jnp.minimum(jnp.where(any_pair, min_pair, big),
+                             min_single)
+        min_sc = jnp.where(jnp.any(counts), min_sc, 1.0)
+        req_ok = jnp.all(jnp.where(required[:, None], present, True),
+                         axis=0)
+        neg_ok = ~jnp.any(jnp.where(negative[:, None], present, False),
+                          axis=0)
+        match = (req_ok & neg_ok & presence_table_ok(present, table)
+                 & (jnp.arange(D) < n_docs_total) & (min_sc < big))
+        final = jnp.where(
+            match, min_sc * final_multipliers(d_siterank, d_doclang,
+                                              qlang), 0.0)
+        ts, ti = jax.lax.approx_max_k(final, k2, recall_target=0.98)
+        return jnp.concatenate([ts, ti.astype(jnp.float32)])
+
+    return jax.vmap(one)(c_slot, c_dslot, c_group, c_base, c_quota,
+                         c_syn, p_start, p_len, p_group, p_base, p_quota,
+                         p_syn, p_isbase, freqw, required, negative,
+                         scored, counts, table, qlang)
+
+
+def main():
+    coll = Collection("bench", "/root/bench_corpus")
+    di = engine.get_device_index(coll)
+    print("ready", flush=True)
+    qs = bench._make_queries(3000, seed=33)
+    f2_cut = min(dv.CUBE_MIN_DF, max(2 * dv.KAPPA_FLOOR, di.n_docs // 8))
+    f2_plans = []
+    for q in qs:
+        p = di.plan(compile_query(q, 0))
+        if p.matchable and p.driver_df > f2_cut:
+            f2_plans.append(p)
+        if len(f2_plans) >= 8 * 8:
+            break
+    print(f"{len(f2_plans)} f2 plans; bmax={di._f2_bmax()}", flush=True)
+    orig = dv._full_cube
+    for stage in range(0, 7):
+        dv._full_cube = partial(f2_staged, stage=stage)
+        t0 = time.perf_counter()
+        jax.block_until_ready(di._run_batch_f2(f2_plans[:8], 64, False))
+        c = time.perf_counter() - t0
+        times = []
+        for i in range(1, 5):
+            t0 = time.perf_counter()
+            jax.block_until_ready(di._run_batch_f2(
+                f2_plans[8 * i:8 * i + 8], 64, False))
+            times.append(time.perf_counter() - t0)
+        print(f"stage {stage}: {1000*min(times):.0f} ms/chunk8 "
+              f"(compile {c:.0f}s)", flush=True)
+    dv._full_cube = orig
+
+
+if __name__ == "__main__":
+    main()

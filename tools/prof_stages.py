@@ -1,0 +1,212 @@
+"""Stage-ablation profiling of the two kernels at 100k docs."""
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import bench
+from open_source_search_engine_tpu.index.collection import Collection
+from open_source_search_engine_tpu.query import engine
+from open_source_search_engine_tpu.query.compiler import compile_query
+from open_source_search_engine_tpu.query.scorer import (
+    final_multipliers, min_scores, presence_table_ok)
+import open_source_search_engine_tpu.query.devindex as dv
+
+STAGE = int(os.environ.get("STAGE", "9"))
+
+
+@partial(jax.jit, static_argnames=("n_positions", "lsp", "kappa", "k2",
+                                   "stage"))
+def f1_staged(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
+              d_siterank, d_doclang, d_dead, n_docs_total,
+              d_slot, d_group, d_base, d_quota, d_syn,
+              s_start, s_len, s_group, s_base, s_quota, s_syn, s_isbase,
+              freqw, required, negative, scored, counts, table, qlang,
+              n_positions: int, lsp: int, kappa: int, k2: int, stage: int):
+    D = d_dead.shape[0]
+    V = d_dense_imp.shape[0]
+    M = d_doc.shape[0]
+    N = d_payload.shape[0]
+    P = n_positions
+    big = jnp.float32(9.99e8)
+
+    def one(d_slot, d_group, d_base, d_quota, d_syn,
+            s_start, s_len, s_group, s_base, s_quota, s_syn, s_isbase,
+            freqw, required, negative, scored, counts, table, qlang):
+        T = required.shape[0]
+        Rd = d_slot.shape[0]
+        Rs = s_start.shape[0]
+        t_ax = jnp.arange(T)
+        live = ~d_dead
+        ubb = jnp.zeros((T, D), jnp.float32)
+        dimp = d_dense_imp[jnp.clip(d_slot, 0, V - 1)]
+        dgate = (d_slot >= 0)
+        for r in range(Rd):
+            contrib = jnp.where(dgate[r], dimp[r], 0.0)
+            ubb = ubb + jnp.where((d_group[r] == t_ax)[:, None],
+                                  contrib[None, :], 0.0)
+        if stage == 0:
+            return ubb.sum(axis=0)[:2 + 2 * k2]
+        lane = jnp.arange(lsp, dtype=jnp.int32)
+        sidx = s_start[:, None] + lane[None, :]
+        smask = lane[None, :] < s_len[:, None]
+        sidxc = jnp.clip(sidx, 0, M - 1)
+        sdoc = d_doc[sidxc]
+        simp = d_imp[sidxc]
+        srsp = d_rsp[sidxc]
+        side = jnp.where(s_isbase, 0, T * D)[:, None]
+        tgt = jnp.where(smask, side + s_group[:, None] * D + sdoc,
+                        2 * T * D)
+        ub2 = jnp.zeros((2 * T * D,), jnp.float32).at[tgt.ravel()].add(
+            jnp.where(smask, simp, 0.0).ravel(), mode="drop"
+        ).reshape(2, T, D)
+        ubb = ubb + ub2[0]
+        ubd = ub2[1]
+        ub = ubb * live[None, :] + ubd
+        rstgt = jnp.where(
+            smask, jnp.arange(Rs, dtype=jnp.int32)[:, None] * D + sdoc,
+            Rs * D)
+        rsacc = jnp.zeros((Rs * D,), jnp.int32).at[rstgt.ravel()].set(
+            jnp.where(smask, srsp, 0).ravel(), mode="drop")
+        if stage == 1:
+            return (ub.sum(axis=0) + rsacc[:D])[:2 + 2 * k2]
+        present = ub > 0.0
+        sc = counts
+        ubw = ub * (freqw * freqw)[:, None]
+        req_ok = jnp.all(jnp.where(required[:, None], present, True),
+                         axis=0)
+        neg_ok = ~jnp.any(jnp.where(negative[:, None], present, False),
+                          axis=0)
+        alive = (req_ok & neg_ok & presence_table_ok(present, table)
+                 & (jnp.arange(D) < n_docs_total))
+        m1 = present & sc[:, None]
+        min_single_ub = jnp.min(jnp.where(m1, ubw, big), axis=0)
+        min_pair_ub = jnp.full((D,), big)
+        any_pair = jnp.zeros((D,), bool)
+        for i in range(T):
+            for j in range(i + 1, T):
+                ok = present[i] & present[j] & sc[i] & sc[j]
+                pu = jnp.sqrt(ubw[i] * ubw[j])
+                min_pair_ub = jnp.where(ok, jnp.minimum(min_pair_ub, pu),
+                                        min_pair_ub)
+                any_pair = any_pair | ok
+        ubmin = jnp.minimum(jnp.where(any_pair, min_pair_ub, big),
+                            min_single_ub)
+        ubmin = jnp.where(jnp.any(sc), ubmin, 1.0)
+        mult = final_multipliers(d_siterank, d_doclang, qlang)
+        ubfinal = jnp.where(alive, ubmin * mult * 1.00001, 0.0)
+        nm = jnp.sum(alive)
+        if stage == 2:
+            return ubfinal[:2 + 2 * k2]
+        cval, cand, ub_missed = dv._block_top2(ubfinal, kappa)
+        if stage == 3:
+            return (cval + cand)[:2 + 2 * k2]
+        dead_c = d_dead[cand]
+        p_ax = jnp.arange(P, dtype=jnp.int32)[:, None]
+        cube = jnp.zeros((T, P, kappa), jnp.uint32)
+        pv = jnp.zeros((T, P, kappa), bool)
+
+        def add_row(cube, pv, rsp_c, group, base, quota, syn, is_base):
+            rs = (rsp_c >> 5).astype(jnp.int32)
+            cnt = rsp_c & 31
+            cnt = jnp.where(is_base & dead_c, 0, cnt)
+            q = p_ax - base
+            sel = (q >= 0) & (q < jnp.minimum(cnt, quota)[None, :])
+            src = rs[None, :] + q
+            val = (d_payload[jnp.clip(src, 0, N - 1)]
+                   | (syn.astype(jnp.uint32) << jnp.uint32(31)))
+            gmask = (group == t_ax)[:, None, None]
+            cube = cube + jnp.where(sel, val, jnp.uint32(0))[None] \
+                * gmask.astype(jnp.uint32)
+            pv = pv | (sel[None] & gmask)
+            return cube, pv
+
+        dense_rsp_c = d_dense_rsp[
+            jnp.clip(d_slot, 0, V - 1)[:, None] * D + cand[None, :]]
+        for r in range(Rd):
+            rsp_c = jnp.where(dgate[r], dense_rsp_c[r], 0)
+            cube, pv = add_row(cube, pv, rsp_c, d_group[r], d_base[r],
+                               d_quota[r], d_syn[r], True)
+        for r in range(Rs):
+            rsp_c = rsacc[r * D + cand]
+            cube, pv = add_row(cube, pv, rsp_c, s_group[r], s_base[r],
+                               s_quota[r], s_syn[r], s_isbase[r])
+        if stage == 4:
+            return cube.sum(axis=(0, 1))[:2 + 2 * k2].astype(jnp.float32)
+        min_sc, present2 = min_scores(cube, pv, freqw, sc)
+        if stage == 5:
+            return min_sc[:2 + 2 * k2]
+        req_ok2 = jnp.all(jnp.where(required[:, None], present2, True),
+                          axis=0)
+        neg_ok2 = ~jnp.any(jnp.where(negative[:, None], present2, False),
+                           axis=0)
+        match2 = (req_ok2 & neg_ok2 & presence_table_ok(present2, table)
+                  & (cval > 0.0) & (min_sc < big))
+        final = jnp.where(
+            match2,
+            min_sc * final_multipliers(d_siterank[cand], d_doclang[cand],
+                                       qlang),
+            0.0)
+        ts, tl = jax.lax.top_k(final, k2)
+        return jnp.concatenate([ts, tl.astype(jnp.float32)])
+
+    return jax.vmap(one)(d_slot, d_group, d_base, d_quota, d_syn,
+                         s_start, s_len, s_group, s_base, s_quota, s_syn,
+                         s_isbase, freqw, required, negative, scored,
+                         counts, table, qlang)
+
+
+def main():
+    coll = Collection("bench", "/root/bench_corpus")
+    di = engine.get_device_index(coll)
+    print(f"ready D={di.D_cap}", flush=True)
+    qs = bench._make_queries(3000, seed=11)
+    f2_cut = min(dv.CUBE_MIN_DF, max(2 * dv.KAPPA_FLOOR, di.n_docs // 8))
+    f1_plans = []
+    for q in qs:
+        p = di.plan(compile_query(q, 0))
+        if p.matchable and not (p.driver_df > f2_cut) \
+                and di._kappa_of(p, 64) == 2048:
+            f1_plans.append(p)
+        if len(f1_plans) >= 32 * 8:
+            break
+    print(f"{len(f1_plans)} kappa-2048 f1 plans", flush=True)
+
+    def run(stage, i):
+        plans = f1_plans[32 * i:32 * i + 32]
+        Rd = dv._bucket(max([len(p.d_slot) for p in plans] + [1]),
+                        dv.RD_FLOOR)
+        Rs = dv._bucket(max([len(p.s_start) for p in plans] + [1]),
+                        dv.RS_FLOOR)
+        # reuse DeviceIndex's padding by calling its _run_batch-like prep
+        out = di._run_batch(plans, 2048, 64)  # warm real path shapes
+        return out
+
+    # time the staged variants by monkeypatching _two_phase
+    orig = dv._two_phase
+    for stage in range(0, 7):
+        dv._two_phase = partial(f1_staged, stage=stage)
+        # compile (FETCH — block_until_ready lies on this backend until
+        # the dispatch queue is flushed by a fetch)
+        t0 = time.perf_counter()
+        jax.device_get(di._run_batch(f1_plans[:32], 2048, 64))
+        c = time.perf_counter() - t0
+        times = []
+        for i in range(1, 5):
+            t0 = time.perf_counter()
+            jax.device_get(di._run_batch(
+                f1_plans[32 * i:32 * i + 32], 2048, 64))
+            times.append(time.perf_counter() - t0)
+        print(f"stage {stage}: {1000*min(times):.0f} ms "
+              f"(compile {c:.0f}s)", flush=True)
+    dv._two_phase = orig
+
+
+if __name__ == "__main__":
+    main()
