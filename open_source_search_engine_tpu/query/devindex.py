@@ -254,6 +254,8 @@ def _block_top2(x, n_sel: int):
     pruning contract as everywhere else."""
     D = x.shape[0]
     nb = max(n_sel // 2, 1)
+    while D % nb:  # D is a power-of-two bucket, but stay safe
+        nb //= 2
     R = D // nb
     xb = x.reshape(nb, R)
     iota = jnp.arange(R, dtype=jnp.int32)[None, :]
@@ -1133,7 +1135,8 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
                 any_pair = any_pair | ok
         ubmin = jnp.minimum(jnp.where(any_pair, min_pair_ub, big),
                             min_single_ub)
-        ubmin = jnp.where(jnp.any(sc), ubmin, 1.0)
+        # per-doc filter-only fallback (mirrors scorer.min_scores)
+        ubmin = jnp.where(jnp.any(m1, axis=0), ubmin, 1.0)
         mult = final_multipliers(d_siterank, d_doclang, qlang)
         ubfinal = jnp.where(alive, ubmin * mult * 1.00001, 0.0)
         nm = jnp.sum(alive)

@@ -193,10 +193,12 @@ def min_scores(cube, pvalid, freq_weight, single_counts):
             any_pair = any_pair | pair_ok
 
     min_score = jnp.minimum(jnp.where(any_pair, min_pair, big), min_single)
-    # filter-only query (e.g. bare "site:x"): nothing contributes to the
-    # min, so matching docs score a constant 1.0 before multipliers
-    has_scoring = jnp.any(single_counts)
-    min_score = jnp.where(has_scoring, min_score, 1.0)
+    # a doc with NO present scored group contributes nothing to the min
+    # — it scores the filter-only constant 1.0 before multipliers. This
+    # is PER-DOC: a boolean query like `site:x OR apple` matches some
+    # docs purely through the unscored filter leaf (bare "site:x"
+    # queries are the all-docs case of the same rule).
+    min_score = jnp.where(jnp.any(s_mask, axis=0), min_score, 1.0)
     return min_score, present
 
 

@@ -1,0 +1,67 @@
+"""Deterministic golden-QA fixture corpus (the reference's magic "test"
+collection, Test.h:10 — fixed inputs, diffable outputs)."""
+
+WORDS = ["alpha", "bravo", "charlie", "delta", "echo", "foxtrot",
+         "golf", "hotel", "india", "juliet", "kilo", "lima"]
+
+
+def golden_docs():
+    """~40 docs over 8 sites with controlled term placement: titles,
+    headings, repeated words, phrases, plurals (synonym targets), and a
+    couple of near-duplicates for checksum dedup."""
+    docs = {}
+    for i in range(36):
+        w1 = WORDS[i % len(WORDS)]
+        w2 = WORDS[(i * 5 + 2) % len(WORDS)]
+        w3 = WORDS[(i * 7 + 5) % len(WORDS)]
+        title = f"{w1.capitalize()} {w2} report {i}"
+        body = (f"<h2>{w2} overview</h2>"
+                f"<p>The {w1} {w2} study number{i} covers {w3} topics. "
+                + (f"{w1} " * (i % 4 + 1))
+                + f"appears often. {w2} {w3} closing remarks.</p>")
+        if i % 6 == 0:
+            body += f"<p>Plural forms: {w1}s and {w2}s everywhere.</p>"
+        docs[f"http://site{i % 8}.golden.test/page{i}"] = (
+            f"<html><head><title>{title}</title></head><body>{body}"
+            "</body></html>")
+    # exact near-duplicates (content-hash dedup targets)
+    dup = ("<html><head><title>Duplicate lima kilo</title></head><body>"
+           "<p>lima kilo duplicate content block.</p></body></html>")
+    docs["http://site1.golden.test/dup-a"] = dup
+    docs["http://site2.golden.test/dup-b"] = dup
+    return docs
+
+
+GOLDEN_QUERIES = [
+    # single terms (incl. synonym targets)
+    "alpha", "bravo", "kilo", "alphas", "report",
+    # conjunctive AND
+    "alpha bravo", "charlie delta report", "echo foxtrot",
+    "india juliet kilo",
+    # phrases
+    '"alpha bravo"', '"closing remarks"', '"lima kilo"',
+    '"bravo overview"',
+    # negation
+    "report -alpha", "bravo -charlie", "kilo -lima",
+    # site filters
+    "site:site0.golden.test alpha", "site:site3.golden.test report",
+    "inurl:page7 report",
+    # boolean trees
+    "alpha AND bravo", "alpha OR bravo", "alpha AND NOT bravo",
+    "(alpha OR bravo) AND charlie", "alpha AND (bravo OR charlie)",
+    "report AND NOT (alpha OR bravo)", "alpha AND -bravo",
+    "lima OR (kilo AND juliet)",
+    # mixed operators
+    '"alpha bravo" -charlie', 'site:site1.golden.test "lima kilo"',
+    "alpha bravo charlie delta",
+    # synonyms / plurals
+    "study", "studies", "topic", "topics", "form", "forms",
+    # misses and edge cases
+    "zulu", "alpha zulu", "-alpha", "report number3",
+    "number12 charlie", "echo echo echo",
+    # deeper multi-term
+    "delta echo foxtrot golf", "overview closing",
+    "bravo study number0", "juliet report -echo",
+    "alpha OR zulu", "zulu OR yankee", "NOT alpha",
+    "site:site0.golden.test OR kilo",  # filter-only matches via OR
+]

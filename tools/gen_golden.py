@@ -1,0 +1,50 @@
+"""Regenerate the golden QA expectations (tests/golden/expected.json).
+
+Run after an INTENTIONAL scoring change, inspect the diff, and commit —
+the reference's qa.cpp golden-CRC model (qa.cpp:3358): the expected
+(docid, score) outputs are pinned so any silent ranking drift fails CI
+with a readable diff.
+"""
+import json
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from open_source_search_engine_tpu.build import docproc  # noqa: E402
+from open_source_search_engine_tpu.index.collection import Collection  # noqa: E402
+from open_source_search_engine_tpu.query import engine  # noqa: E402
+from tests.golden.corpus import GOLDEN_QUERIES, golden_docs  # noqa: E402
+
+
+def main() -> None:
+    coll = Collection("golden", tempfile.mkdtemp(prefix="osse_golden_"))
+    for url, html in golden_docs().items():
+        docproc.index_document(coll, url, html)
+    out = {}
+    for q in GOLDEN_QUERIES:
+        # topk=50 captures whole tie groups: the checkers compare the
+        # tested paths' (smaller) result pages as per-score subsets
+        res = engine.search(coll, q, topk=50, site_cluster=False,
+                            with_snippets=False)
+        out[q] = {
+            "total": res.total_matches,
+            "results": [[int(r.docid), round(float(r.score), 2)]
+                        for r in res.results],
+        }
+    path = Path(__file__).resolve().parent.parent / "tests" / "golden" \
+        / "expected.json"
+    path.write_text(json.dumps(out, indent=1, sort_keys=True) + "\n")
+    print(f"wrote {path} ({len(out)} queries)")
+
+
+if __name__ == "__main__":
+    main()
