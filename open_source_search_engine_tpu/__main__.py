@@ -168,6 +168,31 @@ def cmd_save(args) -> int:
     return 0
 
 
+def cmd_rebalance(args) -> int:
+    """gb scale (main.cpp:2356): grow/shrink the shard grid."""
+    from .control.rebalance import rebalance
+
+    dst = rebalance(args.coll, args.dir, args.out,
+                    old_n_shards=args.old_shards,
+                    new_n_shards=args.new_shards,
+                    n_replicas=args.replicas)
+    print(json.dumps({"shards": dst.n_shards, "docs": dst.num_docs,
+                      "out": args.out}))
+    return 0
+
+
+def cmd_repair(args) -> int:
+    """Repair.h rebuild: posdb/clusterdb/linkdb from titledb."""
+    from .control.rebalance import repair
+    from .index.collection import CollectionDb
+
+    colldb = CollectionDb(args.dir)
+    coll = colldb.get(args.coll, create=False)
+    n = repair(coll)
+    print(json.dumps({"repaired": args.coll, "docs": n}))
+    return 0
+
+
 def cmd_bench(args) -> int:
     import runpy
 
@@ -230,6 +255,20 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("save", help="checkpoint all collections")
     _add_dir(p)
     p.set_defaults(fn=cmd_save)
+
+    p = sub.add_parser("rebalance",
+                       help="re-shard a collection grid (gb scale)")
+    _add_dir(p)
+    p.add_argument("--out", required=True, help="new grid directory")
+    p.add_argument("--old-shards", type=int, required=True)
+    p.add_argument("--new-shards", type=int, required=True)
+    p.add_argument("--replicas", type=int, default=1)
+    p.set_defaults(fn=cmd_rebalance)
+
+    p = sub.add_parser("repair",
+                       help="rebuild index Rdbs from titledb")
+    _add_dir(p)
+    p.set_defaults(fn=cmd_repair)
 
     p = sub.add_parser("bench", help="run the repo benchmark")
     p.set_defaults(fn=cmd_bench)

@@ -457,6 +457,18 @@ class Rdb:
         self.mem.add(neg, [b""] * len(neg) if self.has_data else None)
         self.version += 1
 
+    def wipe(self) -> None:
+        """Drop ALL state (memtable + runs) — the Repair rebuild's
+        'destroy the secondary instance' step (Repair.h:20)."""
+        self.mem.clear()
+        for r in self.runs:
+            shutil.rmtree(r.path, ignore_errors=True)
+        self.runs = []
+        saved = self.dir / "saved"
+        if saved.exists():
+            shutil.rmtree(saved)
+        self.version += 1
+
     def dump(self) -> Run | None:
         """Memtable → new immutable run (RdbDump)."""
         batch = self.mem.batch()

@@ -78,10 +78,13 @@ class HostMap:
         """Linkdb routing: records shard by LINKEE SITE hash so site
         inlink counts and anchor harvests are single-shard reads
         (reference ``getShardNum(RDB_LINKDB)`` keys by linkee site,
-        ``Hostdb.cpp:~2514``)."""
+        ``Hostdb.cpp:~2514``). Derived from the same 32-bit site hash
+        the linkdb KEY embeds, so Rebalance can re-route linkdb records
+        from raw keys (linkdb.shard_of_keys agrees by construction)."""
+        from ..spider.linkdb import _h32
         from ..utils import ghash
         return int(ghash.hash64_array(
-            np.asarray([ghash.hash64(site)], np.uint64))[0]
+            np.asarray([_h32(site)], np.uint64))[0]
             % np.uint64(self.n_shards))
 
     def mark_dead(self, shard: int, replica: int = 0) -> None:

@@ -48,6 +48,15 @@ def pack_key(linkee_site: str, linkee_url: str, linker_site: str,
     return k
 
 
+def shard_of_keys(keys: np.ndarray, num_shards: int) -> np.ndarray:
+    """Owning shard per record from the key's linkee sitehash32 —
+    MUST agree with HostMap.shard_of_site so Rebalance can re-route
+    records without the site string (Rebalance.h:13 rescans raw keys)."""
+    site32 = (keys["n1"] >> np.uint64(32)).astype(np.uint64)
+    return (ghash.hash64_array(site32)
+            % np.uint64(num_shards)).astype(np.int64)
+
+
 def _range(n1_lo: int, n1_hi: int) -> tuple[np.ndarray, np.ndarray]:
     lo = np.zeros((), dtype=KEY_DTYPE)
     lo["n1"] = np.uint64(n1_lo)
