@@ -19,6 +19,49 @@ from ..utils.parms import CollectionConf
 from . import clusterdb, posdb, rdblite, titledb
 
 
+class TermlistCache:
+    """(termid, rdb version) → RecordBatch, byte-bounded LRU."""
+
+    def __init__(self, max_bytes: int = 256 << 20):
+        from collections import OrderedDict
+        self._d: "OrderedDict[tuple[int, int], object]" = OrderedDict()
+        self.max_bytes = max_bytes
+        self.nbytes = 0
+        self._version = -1
+
+    def _roll(self, version: int) -> None:
+        # a version bump strands every entry: drop them all so dead
+        # batches can't pin memory or evict live ones
+        if version != self._version:
+            self._d.clear()
+            self.nbytes = 0
+            self._version = version
+
+    def get(self, termid: int, version: int):
+        from ..utils.stats import g_stats
+        self._roll(version)
+        key = (termid, version)
+        hit = self._d.get(key)
+        if hit is not None:
+            self._d.move_to_end(key)
+            g_stats.count("termlist_cache.hit")
+            return hit
+        g_stats.count("termlist_cache.miss")
+        return None
+
+    def put(self, termid: int, version: int, batch) -> None:
+        self._roll(version)
+        key = (termid, version)
+        if key in self._d:
+            return
+        sz = int(batch.keys.nbytes)
+        self._d[key] = batch
+        self.nbytes += sz
+        while self.nbytes > self.max_bytes and self._d:
+            _, old = self._d.popitem(last=False)
+            self.nbytes -= int(old.keys.nbytes)
+
+
 class Collection:
     def __init__(self, name: str, base_dir: str | Path,
                  conf: CollectionConf | None = None):
@@ -43,6 +86,11 @@ class Collection:
         #: ``RdbCache.h:50``); bounded, dropped wholesale when full
         self.titlerec_cache: dict[int, dict | None] = {}
         self.titlerec_cache_max = 16384
+        #: termlist cache (RdbCache.h:50's biggest customer): merged
+        #: posdb range reads keyed by (termid, posdb version) — any
+        #: write bumps the version, so stale lists can never serve.
+        #: LRU-bounded by total key bytes.
+        self.termlist_cache = TermlistCache()
 
     # --- stats used by ranking ---
 

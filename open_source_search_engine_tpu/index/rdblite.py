@@ -490,25 +490,50 @@ class Rdb:
         return run
 
     def attempt_merge(self, force: bool = False) -> None:
-        """Merge runs down to bound file count (RdbBase::attemptMerge;
-        merge keeps tombstones unless it includes the oldest run, exactly
-        like the reference's 'don't drop negatives unless merging file 0')."""
-        if len(self.runs) <= 1 and not force:
+        """Merge runs down to bound file count (RdbBase::attemptMerge,
+        ``RdbBase.cpp:1400``).
+
+        Write-amplification policy: merge only the NEWEST suffix of
+        runs, sized just enough to bring the count under ``max_runs`` —
+        the LSM-tiered shape where fresh small dumps fold together
+        while the big old base run is left untouched (the reference
+        likewise picks the file subset minimizing resort cost instead
+        of always rewriting everything). ``force=True`` merges the full
+        set (the DailyMerge/manual compaction). Tombstones are kept
+        unless the merge includes the oldest run, exactly the
+        reference's "don't drop negatives unless merging file 0"."""
+        if len(self.runs) <= 1:
             return
-        includes_oldest = True  # we always merge the full set for now
+        if force:
+            start = 0
+        elif len(self.runs) > self.max_runs:
+            # smallest suffix that restores the run-count bound
+            # (len > max_runs ⇒ this keeps exactly max_runs-1 intact)
+            start = self.max_runs - 1
+        else:
+            start = len(self.runs) - 2  # opportunistic: fold newest two
+        suffix = self.runs[start:]
+        includes_oldest = start == 0
         merged = merge_batches(
-            [r.batch() for r in self.runs],
+            [r.batch() for r in suffix],
             keep_tombstones=not includes_oldest,
         )
-        old = self.runs
-        run = Run.write(self.dir / f"run_{self._next_run_id:06d}", merged)
+        old = suffix
+        # the merged run REPLACES the suffix in recency order: derive a
+        # name that sorts right after the surviving prefix
+        # name keeps only the first NUMERIC id so repeated merge cycles
+        # don't grow the filename; the _m counter keeps recency order
+        base_id = int(old[0].path.name.split("_")[1])
+        run = Run.write(
+            self.dir / f"run_{base_id:06d}_m{self._next_run_id:06d}",
+            merged)
         self._next_run_id += 1
-        self.runs = [run]
+        self.runs = self.runs[:start] + [run]
         self.version += 1  # run set moved: device mirrors must re-base
         for r in old:
             shutil.rmtree(r.path)
-        log.debug("%s: merged %d runs -> %s (%d recs)",
-                  self.name, len(old), run.path.name, len(run))
+        log.debug("%s: merged %d newest runs -> %s (%d recs, %d kept)",
+                  self.name, len(old), run.path.name, len(run), start)
 
     # --- reads (Msg5 semantics) ---
 

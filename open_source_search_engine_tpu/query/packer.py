@@ -151,8 +151,13 @@ def fetch_group_lists(coll: Collection, plan: QueryPlan) -> list[GroupList]:
                 "sub": []}
         sub_dfs = [0]
         for s_i, sub in enumerate(g.sublists):
-            batch = coll.posdb.get_list(posdb.start_key(sub.termid),
-                                        posdb.end_key(sub.termid))
+            batch = coll.termlist_cache.get(sub.termid,
+                                            coll.posdb.version)
+            if batch is None:
+                batch = coll.posdb.get_list(posdb.start_key(sub.termid),
+                                            posdb.end_key(sub.termid))
+                coll.termlist_cache.put(sub.termid, coll.posdb.version,
+                                        batch)
             if not len(batch):
                 continue
             f = posdb.unpack(batch.keys)

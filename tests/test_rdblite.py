@@ -203,3 +203,101 @@ class TestRdb:
         got = set(zip(f["termid"].tolist(), f["docid"].tolist(),
                       f["wordpos"].tolist()))
         assert got == seen
+
+
+class TestMergePolicy:
+    """attemptMerge write-amp policy (RdbBase.cpp:1400): only the newest
+    suffix of runs merges; the big old base run is not rewritten."""
+
+    def test_suffix_merge_keeps_base_run(self, tmp_path):
+        import numpy as np
+
+        from open_source_search_engine_tpu.index import posdb
+        from open_source_search_engine_tpu.index.rdblite import Rdb
+
+        rdb = Rdb("posdb", tmp_path, posdb.KEY_DTYPE, max_runs=3)
+        # one big base run + several small dumps
+        big = posdb.pack(termid=1, docid=np.arange(1, 5001, dtype=np.uint64),
+                         wordpos=1, densityrank=1, siterank=0, hashgroup=0,
+                         langid=1)
+        rdb.add(big)
+        rdb.dump()
+        base_name = rdb.runs[0].path.name
+        for i in range(4):
+            small = posdb.pack(termid=10 + i,
+                               docid=np.arange(1, 51, dtype=np.uint64),
+                               wordpos=2, densityrank=1, siterank=0,
+                               hashgroup=0, langid=1)
+            rdb.add(small)
+            rdb.dump()
+        assert len(rdb.runs) <= 3 + 1
+        rdb.attempt_merge()
+        assert len(rdb.runs) <= 3
+        # the base run was never rewritten
+        assert rdb.runs[0].path.name == base_name
+        # every record still served
+        assert len(rdb.get_all()) == 5000 + 4 * 50
+
+    def test_forced_full_merge(self, tmp_path):
+        import numpy as np
+
+        from open_source_search_engine_tpu.index import posdb
+        from open_source_search_engine_tpu.index.rdblite import Rdb
+
+        rdb = Rdb("posdb", tmp_path, posdb.KEY_DTYPE, max_runs=8)
+        for t in range(3):
+            rdb.add(posdb.pack(termid=t + 1,
+                               docid=np.arange(1, 11, dtype=np.uint64),
+                               wordpos=1, densityrank=1, siterank=0,
+                               hashgroup=0, langid=1))
+            rdb.dump()
+        rdb.attempt_merge(force=True)
+        assert len(rdb.runs) == 1
+        assert len(rdb.get_all()) == 30
+
+    def test_merged_runs_reload_in_order(self, tmp_path):
+        import numpy as np
+
+        from open_source_search_engine_tpu.index import posdb
+        from open_source_search_engine_tpu.index.rdblite import Rdb
+
+        rdb = Rdb("posdb", tmp_path, posdb.KEY_DTYPE, max_runs=2)
+        for t in range(5):
+            rdb.add(posdb.pack(termid=t + 1,
+                               docid=np.arange(1, 6, dtype=np.uint64),
+                               wordpos=1, densityrank=1, siterank=0,
+                               hashgroup=0, langid=1))
+            rdb.dump()
+        names = [r.path.name for r in rdb.runs]
+        rdb2 = Rdb("posdb", tmp_path, posdb.KEY_DTYPE, max_runs=2)
+        assert [r.path.name for r in rdb2.runs] == names
+        assert len(rdb2.get_all()) == len(rdb.get_all()) == 25
+
+
+class TestTermlistCache:
+    """RdbCache-style termlist cache: hits on repeat queries, version-
+    keyed so a write can never serve a stale list."""
+
+    def test_hits_and_version_invalidation(self, tmp_path):
+        from open_source_search_engine_tpu.build import docproc
+        from open_source_search_engine_tpu.index.collection import Collection
+        from open_source_search_engine_tpu.query import engine
+        from open_source_search_engine_tpu.utils.stats import g_stats
+
+        c = Collection("tc", tmp_path)
+        docproc.index_document(
+            c, "http://t.test/a",
+            "<html><head><title>Cache</title></head><body>"
+            "<p>cache me twice.</p></body></html>")
+        g_stats.counters.pop("termlist_cache.hit", None)
+        engine.search(c, "cache", topk=5, with_snippets=False)
+        h0 = g_stats.counters.get("termlist_cache.hit", 0)
+        engine.search(c, "cache", topk=5, with_snippets=False)
+        assert g_stats.counters.get("termlist_cache.hit", 0) > h0
+        # a write bumps the version: fresh results, no stale serve
+        docproc.index_document(
+            c, "http://t.test/b",
+            "<html><head><title>Cache two</title></head><body>"
+            "<p>cache again here.</p></body></html>")
+        res = engine.search(c, "cache", topk=5, with_snippets=False)
+        assert res.total_matches == 2
