@@ -117,9 +117,13 @@ def cmd_crawl(args) -> int:
     from .index.collection import CollectionDb
     from .spider.loop import SpiderLoop
 
+    from .spider.spiderdb import DurableSpiderScheduler
+
     colldb = CollectionDb(args.dir)
     coll = colldb.get(args.coll)
-    loop = SpiderLoop(coll)
+    sched = DurableSpiderScheduler(
+        Path(args.dir) / "spider" / args.coll)
+    loop = SpiderLoop(coll, scheduler=sched)
     for seed in (args.seeds or "").split(","):
         if seed.strip():
             loop.add_url(seed.strip())

@@ -570,6 +570,13 @@ class Rdb:
         for p in sorted(self.dir.glob("run_*")):
             if p.is_dir() and not p.name.endswith(".tmp"):
                 self.runs.append(Run(p))
-                self._next_run_id = max(
-                    self._next_run_id, int(p.name.split("_")[1]) + 1)
+                parts = p.name.split("_")
+                self._next_run_id = max(self._next_run_id,
+                                        int(parts[1]) + 1)
+                if len(parts) > 2 and parts[2].startswith("m"):
+                    # merged runs carry the id counter in the _m suffix:
+                    # it must survive restarts or the next merge reuses
+                    # a live name
+                    self._next_run_id = max(self._next_run_id,
+                                            int(parts[2][1:]) + 1)
         self.load_saved()

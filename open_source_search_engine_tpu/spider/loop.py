@@ -44,7 +44,10 @@ class SpiderLoop:
                  = None, fetcher: Fetcher | None = None,
                  batch_size: int = 8):
         self.target = coll_or_sharded
-        self.sched = scheduler or SpiderScheduler()
+        # `scheduler or ...` would discard an EMPTY scheduler (len()==0
+        # makes it falsy) — a durable frontier always starts empty
+        self.sched = scheduler if scheduler is not None \
+            else SpiderScheduler()
         self.fetcher = fetcher or Fetcher()
         self.batch_size = batch_size
         self.stats = CrawlStats()
@@ -76,7 +79,16 @@ class SpiderLoop:
             return 0
         results = self.fetcher.fetch_many([r.url for r in batch])
         indexed = 0
+        mark_done = getattr(self.sched, "mark_done", None)
         for req, res in zip(batch, results):
+            if mark_done is not None and not (
+                    res.status == 0 or res.status == 999
+                    or 500 <= res.status < 600):
+                # SpiderReply write — but only for COMPLETED attempts
+                # (success or permanent 4xx); network errors, 5xx, and
+                # robots blocks stay unreplied so the url re-doles on a
+                # later crawl (the reference schedules error retries)
+                mark_done(req.url)
             self.stats.fetched += 1
             self.stats.by_status[res.status] = \
                 self.stats.by_status.get(res.status, 0) + 1
@@ -104,6 +116,9 @@ class SpiderLoop:
                     continue
                 self.stats.links_found += 1
                 self.sched.add_url(absu, hopcount=req.hopcount + 1)
+        cp = getattr(self.sched, "checkpoint", None)
+        if cp is not None:
+            cp()  # batch-granular durability (addsinprogress semantics)
         return indexed
 
     def crawl(self, max_pages: int = 100, max_steps: int | None = None

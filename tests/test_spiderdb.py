@@ -1,0 +1,110 @@
+"""Durable spiderdb frontier tests (VERDICT round-2 item 6).
+
+Reference contracts: SpiderRequests/Replies in a real Rdb keyed by
+(host, urlhash) (Spider.h:388,468), firstIP-style host-hash sharding
+(Hostdb.cpp:~2526), and restart-safe doling (the reply record is the
+never-refetch witness; an unreplied request always re-doles)."""
+
+from open_source_search_engine_tpu.spider.spiderdb import (
+    DurableSpiderScheduler, shard_of_url, urlhash63)
+
+
+def urls(n, host="a.test"):
+    return [f"http://{host}/p{i}" for i in range(n)]
+
+
+class TestDurableFrontier:
+    def test_checkpoint_restart_resumes_exact_frontier(self, tmp_path):
+        s = DurableSpiderScheduler(tmp_path, max_hops=5)
+        for u in urls(20):
+            assert s.add_url(u)
+        batch = []
+        for i in range(8):  # one per politeness window (same host)
+            batch += s.next_batch(1, now=1000.0 * (i + 1))
+        assert len(batch) == 8
+        for r in batch:
+            s.mark_done(r.url)
+        s.checkpoint()
+
+        # "kill -9": drop the object without any further save
+        done = {r.url for r in batch}
+        s2 = DurableSpiderScheduler(tmp_path, max_hops=5)
+        assert len(s2) == 12                      # frontier not lost
+        doled = []
+        t = 1e12
+        while not s2.exhausted:
+            t += 1000.0
+            doled += [r.url for r in s2.next_batch(50, now=t)]
+        assert set(doled) == set(urls(20)) - done  # no re-fetches
+        # completed + pending urls stay deduped after restart
+        for u in urls(20):
+            assert not s2.add_url(u)
+
+    def test_unreplied_inflight_redoles(self, tmp_path):
+        s = DurableSpiderScheduler(tmp_path)
+        for u in urls(4, host="b.test"):
+            s.add_url(u)
+        inflight = (s.next_batch(1, now=1e9)
+                    + s.next_batch(1, now=2e9))  # doled, crash pre-reply
+        s.checkpoint()
+        s2 = DurableSpiderScheduler(tmp_path)
+        redo = {r.url for r in (s2.next_batch(50, now=1e12)
+                                + s2.next_batch(50, now=2e12))}
+        # the in-flight urls come back (fetch-twice, never lost)
+        assert {r.url for r in inflight} <= redo
+
+    def test_every_add_survives_a_crash(self, tmp_path):
+        """The addsinprogress journal makes each accepted url durable
+        BEFORE the ack — kill -9 at any point loses nothing."""
+        s = DurableSpiderScheduler(tmp_path)
+        for u in urls(6, host="c.test"):
+            s.add_url(u)
+        s.add_url("http://c.test/late")           # never checkpointed
+        s2 = DurableSpiderScheduler(tmp_path)     # crash-restart
+        assert len(s2) == 7
+        assert not s2.add_url("http://c.test/late")  # still deduped
+
+    def test_host_hash_sharding_consistent(self):
+        for u in ["http://x.test/a", "http://x.test/b"]:
+            assert shard_of_url(u, 4) == shard_of_url("http://x.test/z", 4)
+        spread = {shard_of_url(f"http://h{i}.test/", 8) for i in range(64)}
+        assert len(spread) > 4                    # spreads across shards
+
+    def test_crawl_loop_integration(self, tmp_path):
+        from open_source_search_engine_tpu.index.collection import Collection
+        from open_source_search_engine_tpu.spider.fetcher import (
+            Fetcher, FetchResult)
+        from open_source_search_engine_tpu.spider.loop import SpiderLoop
+
+        pages = {
+            f"http://crawl.test/p{i}": (
+                f"<html><head><title>P{i}</title></head><body>"
+                f"<p>page {i} words"
+                + (f' <a href="/p{i+1}">next</a>' if i < 5 else "")
+                + "</p></body></html>")
+            for i in range(6)
+        }
+
+        class FakeFetcher(Fetcher):
+            def fetch_many(self, urls, **kw):
+                return [FetchResult(url=u, status=200,
+                                    content=pages.get(u, ""),
+                                    content_type="text/html")
+                        for u in urls]
+
+        c = Collection("crawl", tmp_path / "coll")
+        sched = DurableSpiderScheduler(tmp_path / "sp", max_hops=10)
+        loop = SpiderLoop(c, scheduler=sched, fetcher=FakeFetcher(),
+                          batch_size=2)
+        loop.add_url("http://crawl.test/p0")
+        # politeness: same host, so drain with many steps
+        for _ in range(30):
+            loop.crawl_step()
+            sched.host_ready_at.clear()           # fast-forward politeness
+            if sched.exhausted:
+                break
+        assert loop.stats.indexed == 6
+        # restart: everything replied, frontier empty, nothing refetches
+        s2 = DurableSpiderScheduler(tmp_path / "sp", max_hops=10)
+        assert len(s2) == 0
+        assert not s2.add_url("http://crawl.test/p3")
