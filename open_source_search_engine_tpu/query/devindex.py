@@ -93,6 +93,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..index import clusterdb as clusterdb_mod
 from ..index import posdb
 from ..index.collection import Collection
 from ..index.rdblite import merge_batches
@@ -352,6 +353,7 @@ class DeviceIndex:
         rdb = self.coll.posdb
         if rdb.version == self._built_version:
             return False
+        self._sitehash = None  # clusterdb view refreshes lazily
         fp = tuple((r.path.name, len(r)) for r in rdb.runs)
         if fp != self._base_fp:
             self._build_base(fp)
@@ -688,6 +690,26 @@ class DeviceIndex:
     @property
     def n_docs(self) -> int:
         return len(self.all_docids)
+
+    def sitehash_of(self, docid: int) -> int:
+        """Query-time clusterdb read (Clusterdb.h:42 / Msg51.h:96):
+        the docid's 26-bit sitehash from the dataless clusterdb records
+        — site clustering runs off this column WITHOUT touching titledb
+        until the summary stage. Lazily built, aligned to all_docids."""
+        if getattr(self, "_sitehash", None) is None:
+            cl = self.coll.clusterdb.get_all()
+            sh = np.zeros(len(self.all_docids), np.int64)
+            if len(cl):
+                f = clusterdb_mod.unpack_key(cl.keys)
+                pos = np.searchsorted(self.all_docids, f["docid"])
+                ok = pos < len(self.all_docids)
+                ok[ok] = self.all_docids[pos[ok]] == f["docid"][ok]
+                sh[pos[ok]] = f["sitehash"][ok].astype(np.int64)
+            self._sitehash = sh
+        i = int(np.searchsorted(self.all_docids, np.uint64(docid)))
+        if i < len(self.all_docids) and self.all_docids[i] == docid:
+            return int(self._sitehash[i])
+        return 0
 
     # --- planning --------------------------------------------------------
 

@@ -59,7 +59,8 @@ class SearchResults:
 def build_results(get_doc, docids, scores, plan: QueryPlan, *,
                   topk: int, with_snippets: bool = True,
                   site_cluster: bool = True,
-                  dedup_content: bool = True) -> tuple[list[Result], int]:
+                  dedup_content: bool = True,
+                  site_of=None) -> tuple[list[Result], int]:
     """Msg40's post-merge stage: walk merged candidates best-first, fetch
     titlerecs from the owning store (Msg20/Msg22), apply content-hash
     dedup (Msg40's checksum dedup of identical pages) and site clustering
@@ -71,7 +72,7 @@ def build_results(get_doc, docids, scores, plan: QueryPlan, *,
     from . import summary as summary_mod
 
     words = [g.display for g in plan.scored_groups]
-    per_site: dict[str, int] = {}
+    per_site: dict = {}
     seen_hashes: set[int] = set()
     results: list[Result] = []
     clustered = 0
@@ -80,6 +81,14 @@ def build_results(get_doc, docids, scores, plan: QueryPlan, *,
             break
         if score <= 0.0:
             continue
+        if site_cluster and site_of is not None:
+            # clusterdb-driven clustering (Msg51.h:96): the sitehash
+            # column decides BEFORE any titledb fetch, so hidden
+            # results never decompress a titlerec
+            sh = site_of(int(docid))
+            if sh and per_site.get(sh, 0) >= MAX_PER_SITE:
+                clustered += 1
+                continue
         rec = get_doc(int(docid))
         r = Result(docid=int(docid), score=float(score))
         if rec:
@@ -92,7 +101,11 @@ def build_results(get_doc, docids, scores, plan: QueryPlan, *,
                     clustered += 1
                     continue
                 seen_hashes.add(ch)
-            if site_cluster and r.site:
+            if site_cluster and site_of is not None:
+                sh = site_of(int(docid))
+                if sh:
+                    per_site[sh] = per_site.get(sh, 0) + 1
+            elif site_cluster and r.site:
                 seen = per_site.get(r.site, 0)
                 if seen >= MAX_PER_SITE:
                     clustered += 1
@@ -198,7 +211,8 @@ def search_device_batch(coll: Collection, queries, *, topk: int = 10,
         results, clustered = build_results(
             lambda d: docproc.get_document(coll, docid=d),
             docids, scores, plan, topk=topk,
-            with_snippets=with_snippets, site_cluster=site_cluster)
+            with_snippets=with_snippets, site_cluster=site_cluster,
+            site_of=di.sitehash_of)
         out.append(SearchResults(
             query=plan.raw, total_matches=n_matched, results=results,
             clustered=clustered,

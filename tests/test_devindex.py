@@ -306,3 +306,51 @@ class TestFullCubePath:
                        fromlist=["compile_query"]).compile_query("common"))
         assert p.driver_df > dv.CUBE_MIN_DF
         assert len(di.cube_slot_of) > 0  # cube rows materialized
+
+
+class TestClusterdbRead:
+    """Query-time clusterdb use (Clusterdb.h:42, Msg51.h:96): the
+    sitehash column clusters results BEFORE any titledb access."""
+
+    def test_sitehash_clustering_matches_titlerec_clustering(self, coll):
+        di = get_device_index(coll)
+        # sitehashes exist for every doc and group by site
+        a = di.sitehash_of(
+            __import__("open_source_search_engine_tpu.utils.ghash",
+                       fromlist=["doc_id"]).doc_id(
+                "http://a.example.com/fruit"))
+        assert a != 0
+        host = engine.search(coll, "apple", topk=10, site_cluster=True)
+        dev = search_device(coll, "apple", topk=10, site_cluster=True)
+        assert {r.url for r in dev.results} == {r.url for r in host.results}
+        assert dev.clustered == host.clustered
+
+    def test_hidden_results_skip_titledb(self, tmp_path):
+        c = Collection("clu", tmp_path)
+        for i in range(6):
+            docproc.index_document(
+                c, f"http://one.site.test/p{i}",
+                f"<html><head><title>Page {i} shared</title></head>"
+                f"<body><p>shared words everywhere {i}.</p></body></html>")
+        fetched = []
+        orig = docproc.get_document
+
+        def spy(coll_, url=None, docid=None):
+            fetched.append(docid)
+            return orig(coll_, url=url, docid=docid)
+
+        import open_source_search_engine_tpu.query.engine as eng
+        di = get_device_index(c)
+        raw = di.search_batch(["shared"], topk=64)
+        from open_source_search_engine_tpu.query.compiler import (
+            compile_query)
+        docids, scores, nm = raw[0]
+        results, clustered = eng.build_results(
+            lambda d: spy(c, docid=d), docids, scores,
+            compile_query("shared"), topk=10, with_snippets=False,
+            site_cluster=True, site_of=di.sitehash_of)
+        assert nm == 6 and clustered == 4
+        assert len(results) == 2
+        # only the 2 served results touched titledb — the 4 hidden by
+        # clustering were decided from the clusterdb sitehash column
+        assert len(fetched) == 2
