@@ -1148,8 +1148,9 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
         min_single_ub = jnp.min(jnp.where(m1, ubw, big), axis=0)
         min_pair_ub = jnp.full((D,), big)
         any_pair = jnp.zeros((D,), bool)
+        from .scorer import MAX_PAIR_SPAN
         for i in range(T):
-            for j in range(i + 1, T):
+            for j in range(i + 1, min(i + 1 + MAX_PAIR_SPAN, T)):
                 ok = present[i] & present[j] & sc[i] & sc[j]
                 pu = jnp.sqrt(ubw[i] * ubw[j])
                 min_pair_ub = jnp.where(ok, jnp.minimum(min_pair_ub, pu),

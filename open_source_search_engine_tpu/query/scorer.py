@@ -54,6 +54,11 @@ from .packer import MAX_POSITIONS, TABLE_SIZE, PackedQuery
 
 QDIST = 2.0  # default query-distance (Posdb.cpp:6886)
 
+#: max query-term index distance for pair scoring (bounds the unrolled
+#: P×P cross products at wide T buckets; pairs of distant query words
+#: contribute least under the min algorithm)
+MAX_PAIR_SPAN = 4
+
 
 def _decode(payload: jnp.ndarray):
     """Unpack the uint32 posting payload (packer bit layout)."""
@@ -162,11 +167,16 @@ def min_scores(cube, pvalid, freq_weight, single_counts):
     min_single = jnp.min(jnp.where(s_mask, single, big), axis=0)    # [D]
 
     # ---- pair scores: exact max over P×P per (i, j) ----
+    # pair work is capped at nearby query-term pairs (span ≤ MAX_PAIR_
+    # SPAN): a 16-group bucket would otherwise unroll 120 P×P cross
+    # products (compile-time and HBM both explode — the reference caps
+    # pair work too, MAX_TOP/Posdb.h:817). Queries with ≤ 5 groups are
+    # unaffected: every pair is within the span.
     in_body = _tiny_lookup(weights.IN_BODY, hg) > 0.5      # [T, P, D]
     min_pair = jnp.full((D,), big)
     any_pair = jnp.zeros((D,), jnp.bool_)
     for i in range(T):
-        for j in range(i + 1, T):
+        for j in range(i + 1, min(i + 1 + MAX_PAIR_SPAN, T)):
             delta = (wordpos[j][None, :, :]
                      - wordpos[i][:, None, :]).astype(jnp.float32)
             d_plain = jnp.maximum(jnp.abs(delta), 2.0)     # [P, P, D]
