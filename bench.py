@@ -108,17 +108,17 @@ def main() -> None:
     di = engine.get_device_index(coll)
     device_build_s = time.perf_counter() - t0
 
-    warm_qs = _make_queries(4 * BATCH + N_LAT + 8, seed=99)
+    warm_qs = _make_queries(8 * BATCH + N_LAT + 8, seed=99)
     meas_qs = _make_queries(N_QUERIES, seed=7)
     lat_qs = _make_queries(N_LAT, seed=1234)
     # (different seeds overlap rarely; uniqueness within each set is
     # what defeats the dispatch cache — warm queries are never measured)
 
     t0 = time.perf_counter()
-    for i in range(0, 4 * BATCH, BATCH):  # warm batch buckets (B=32)
+    for i in range(0, 8 * BATCH, BATCH):  # warm batch buckets (B=32)
         engine.search_device_batch(coll, warm_qs[i:i + BATCH], topk=10,
                                    with_snippets=False)
-    for q in warm_qs[4 * BATCH:]:          # warm single buckets (B=4)
+    for q in warm_qs[8 * BATCH:]:          # warm single buckets (B=4)
         engine.search_device(coll, q, topk=10, with_snippets=False)
     warm_s = time.perf_counter() - t0
 
