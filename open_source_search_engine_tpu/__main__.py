@@ -87,6 +87,9 @@ def cmd_inject(args) -> int:
                if args.file else sys.stdin.read())
     ml = docproc.index_document(coll, args.url, content)
     colldb.save_all()
+    if ml is None:
+        print(json.dumps({"injected": args.url, "error": "banned"}))
+        return 1
     print(json.dumps({"injected": args.url, "docid": int(ml.docid),
                       "docs": coll.num_docs}))
     return 0
@@ -122,7 +125,8 @@ def cmd_crawl(args) -> int:
     colldb = CollectionDb(args.dir)
     coll = colldb.get(args.coll)
     sched = DurableSpiderScheduler(
-        Path(args.dir) / "spider" / args.coll)
+        Path(args.dir) / "spider" / args.coll,
+        banned=coll.tagdb.is_banned)
     loop = SpiderLoop(coll, scheduler=sched)
     for seed in (args.seeds or "").split(","):
         if seed.strip():

@@ -65,6 +65,12 @@ class MetaList:
     #: linkees whose anchor set this add/remove touched — the next
     #: propagation wave (consumed by :func:`refresh_linkees`)
     refresh_targets: list = field(default_factory=list)
+    #: resolved outlink edges [(linkee Url, anchor)] and the linkee →
+    #: site-boundary map FROZEN at build time (stored in the TitleRec,
+    #: so the delete path tombstones linkdb edges under the exact keys
+    #: the add wrote, even if tagdb boundaries changed since)
+    edges: list = field(default_factory=list)
+    edge_sites: dict = field(default_factory=dict)
 
 
 def _density_ranks(hashgroups: np.ndarray, sentences: np.ndarray) -> np.ndarray:
@@ -118,6 +124,9 @@ def build_meta_list(
     delete: bool = False,
     ts: float | None = None,
     inlinks: list | None = None,
+    site: str | None = None,
+    site_resolver=None,
+    linkee_sites: dict | None = None,
 ) -> MetaList:
     """Compute every record one document contributes. ``delete=True``
     produces the same records as tombstones (reference: the old doc's
@@ -128,11 +137,24 @@ def build_meta_list(
     postings with the linker's siterank in the wordspamrank slot
     (``XmlDoc::hashIncomingLinkText``; LINKER_WEIGHTS applies
     sqrt(1+siterank), ``Posdb.cpp:1136``). The snapshot is stored in the
-    TitleRec so the delete path regenerates the exact same postings."""
+    TitleRec so the delete path regenerates the exact same postings.
+
+    ``site`` overrides the url-derived site boundary (SiteGetter/tagdb
+    ``sitepathdepth`` — a subdirectory site on a hosting domain); it
+    flows into the site: term, the clusterdb sitehash, and the stored
+    TitleRec, so clustering and fielded search honor the boundary.
+    ``site_resolver`` (normally ``Tagdb.site_of``) freezes each
+    outlink's site boundary into the TitleRec; ``linkee_sites`` replays
+    a stored map on the tombstone path so delete keys match add keys."""
     u = normalize(url)
+    site = site or u.site
     docid = ghash.doc_id(u.full)
     tdoc: TokenizedDoc = (tokenize_html(content, u.full) if is_html
                           else tokenize_text(content))
+    edges = resolve_links(tdoc.links, u.full)
+    if linkee_sites is None:
+        resolver = site_resolver or (lambda lu: lu.site)
+        linkee_sites = {lk.full: resolver(lk) for lk, _ in edges}
 
     doc_words = [t.word for t in tdoc.tokens]
     words = list(doc_words)
@@ -208,7 +230,7 @@ def build_meta_list(
 
     # site: term for fielded search (reference hashUrl/hashIncomingLinkText
     # emit site:/inurl: prefixed terms)
-    site_tid = ghash.term_id(u.site, prefix=SITE_PREFIX)
+    site_tid = ghash.term_id(site, prefix=SITE_PREFIX)
     content_hash = ghash.hash64(tdoc.text or content)
     extra_terms = posdb.pack(
         termid=[site_tid,
@@ -225,14 +247,15 @@ def build_meta_list(
     else:
         title_rec = titledb.make_title_rec(
             url=u.full, title=tdoc.title.strip(), text=tdoc.text,
-            links=tdoc.links, site=u.site, langid=langid, siterank=siterank,
+            links=tdoc.links, site=site, langid=langid, siterank=siterank,
             content_hash=content_hash,
             ts=ts if ts is not None else time.time(),
             extra={"content": content, "is_html": is_html,
                    "meta_description": tdoc.meta_description,
-                   "inlinks": [[t, sr] for t, sr in inlinks]},
+                   "inlinks": [[t, sr] for t, sr in inlinks],
+                   "linkee_sites": linkee_sites},
         )
-    sitehash = ghash.hash64(u.site) & ((1 << clusterdb.SITEHASH_BITS) - 1)
+    sitehash = ghash.hash64(site) & ((1 << clusterdb.SITEHASH_BITS) - 1)
     return MetaList(
         docid=docid,
         posdb_keys=posdb_keys,
@@ -241,8 +264,10 @@ def build_meta_list(
         clusterdb_key=clusterdb.pack_key(docid, sitehash, langid, 0, delbit),
         links=tdoc.links,
         langid=langid,
-        site=u.site,
+        site=site,
         words=doc_words,
+        edges=edges,
+        edge_sites=linkee_sites,
     )
 
 
@@ -257,11 +282,11 @@ def absolutize(base: str, href: str) -> str | None:
     return absu
 
 
-def outlink_edges(ml: MetaList, linker_url: str):
-    """Normalized (linkee, anchor) pairs for a meta list's outlinks —
-    the linkdb records the reference's meta list carries."""
+def resolve_links(links: list[tuple[str, str]], linker_url: str):
+    """Normalized (linkee, anchor) pairs for raw hrefs — the linkdb
+    records the reference's meta list carries."""
     out = []
-    for href, anchor in ml.links:
+    for href, anchor in links:
         absu = absolutize(linker_url, href)
         if not absu:
             continue
@@ -271,6 +296,10 @@ def outlink_edges(ml: MetaList, linker_url: str):
             continue
         out.append((linkee, anchor))
     return out
+
+
+def outlink_edges(ml: MetaList, linker_url: str):
+    return ml.edges or resolve_links(ml.links, linker_url)
 
 
 def needs_link_refresh(fresh: list, stored: list) -> bool:
@@ -296,7 +325,8 @@ MAX_REFRESH_DEPTH = 8
 
 
 def refresh_linkees(linkees, own_site: str, *, get_doc, linkdb_of,
-                    reindex, max_depth: int = MAX_REFRESH_DEPTH) -> None:
+                    reindex, max_depth: int = MAX_REFRESH_DEPTH,
+                    site_of=None) -> None:
     """Shared propagate step (single-node and sharded flows): for each
     external linkee already indexed, compare its stored inlink snapshot
     with a fresh harvest and reindex when stale.
@@ -309,18 +339,19 @@ def refresh_linkees(linkees, own_site: str, *, get_doc, linkdb_of,
     a page is refreshed at most once per propagation."""
     from collections import deque
 
+    site_of = site_of or (lambda u: u.site)
     seen: set[str] = set()
     work = deque((lk, own_site, 0) for lk in linkees)
     while work:
         linkee, src_site, depth = work.popleft()
-        if linkee.site == src_site or linkee.full in seen:
+        lk_site = site_of(linkee)
+        if lk_site == src_site or linkee.full in seen:
             continue
         seen.add(linkee.full)
         rec = get_doc(linkee)
         if rec is None:
             continue
-        fresh = linkdb_of(linkee.site).inlinks_for_url(linkee.site,
-                                                       linkee.full)
+        fresh = linkdb_of(lk_site).inlinks_for_url(lk_site, linkee.full)
         stored = [tuple(x) for x in rec.get("inlinks") or []]
         if needs_link_refresh(fresh, stored):
             ml = reindex(linkee, rec)
@@ -338,12 +369,25 @@ def index_document(coll: Collection, url: str, content: str, *,
     harvest this URL's inlink anchor text from linkdb (Msg25 LinkInfo),
     add the new records, record outlink edges, and re-index any already-
     indexed linkee whose anchor set changed — including linkees the OLD
-    version linked to and the new one doesn't (their anchor goes away)."""
-    old = remove_document(coll, url, _count=False, propagate=False)
+    version linked to and the new one doesn't (their anchor goes away).
+
+    Tagdb gates the whole flow (XmlDoc::indexDoc's EDOCBANNED path): a
+    ``manualban`` on a containing site drops any indexed version and
+    returns None; ``sitepathdepth`` widens the site boundary;
+    ``siterank`` pins site quality over the link-derived rank."""
     u = normalize(url)
-    inlinks = coll.linkdb.inlinks_for_url(u.site, u.full)
+    banned, site, sr_override = coll.tagdb.index_gate(u)
+    if banned:
+        remove_document(coll, url, propagate=propagate)
+        log.info("tagdb manualban: %s not indexed", url)
+        return None
+    if sr_override is not None:
+        siterank = sr_override
+    old = remove_document(coll, url, _count=False, propagate=False)
+    inlinks = coll.linkdb.inlinks_for_url(site, u.full)
     ml = build_meta_list(url, content, is_html=is_html, siterank=siterank,
-                         langid=langid, inlinks=inlinks)
+                         langid=langid, inlinks=inlinks, site=site,
+                         site_resolver=coll.tagdb.site_of)
     coll.posdb.add(ml.posdb_keys)
     coll.titledb.add(ml.titledb_key.reshape(1), [ml.title_rec])
     coll.clusterdb.add(ml.clusterdb_key.reshape(1))
@@ -355,21 +399,23 @@ def index_document(coll: Collection, url: str, content: str, *,
     # record outlink edges with anchor text (this page's siterank is the
     # linker rank riding each edge), then refresh affected linkees:
     # the new edge set plus any former linkees whose edge was tombstoned
-    edges = outlink_edges(ml, u.full)
+    edges = ml.edges
     for linkee, anchor in edges:
-        coll.linkdb.add_link(linkee.site, u.site, u.full,
-                             linkee_url=linkee.full, anchor_text=anchor,
-                             linker_siterank=siterank)
+        coll.linkdb.add_link(
+            ml.edge_sites.get(linkee.full, linkee.site), site, u.full,
+            linkee_url=linkee.full, anchor_text=anchor,
+            linker_siterank=siterank)
     ml.refresh_targets = [e[0] for e in edges]
     if old is not None:
         ml.refresh_targets += old.refresh_targets
     if propagate:
         refresh_linkees(
-            ml.refresh_targets, u.site,
+            ml.refresh_targets, site,
             get_doc=lambda lk: get_document(coll, url=lk.full),
             linkdb_of=lambda _site: coll.linkdb,
             reindex=lambda lk, rec: reindex_document(
-                coll, lk.full, propagate=False))
+                coll, lk.full, propagate=False),
+            site_of=coll.tagdb.site_of)
     log.debug("indexed %s docid=%d keys=%d inlinks=%d", url, ml.docid,
               len(ml.posdb_keys), len(inlinks))
     return ml
@@ -388,7 +434,8 @@ def reindex_document(coll: Collection, url: str, *,
     return index_document(
         coll, url, rec.get("content", rec["text"]),
         is_html=rec.get("is_html", True),
-        siterank=site_rank(coll.linkdb.site_num_inlinks(u.site)),
+        siterank=site_rank(
+            coll.linkdb.site_num_inlinks(coll.tagdb.site_of(u))),
         langid=rec.get("langid"), propagate=propagate)
 
 
@@ -404,7 +451,9 @@ def tombstone_meta_list(rec: dict) -> MetaList:
                            langid=rec.get("langid"), delete=True,
                            ts=rec.get("ts"),
                            inlinks=[tuple(x) for x in
-                                    rec.get("inlinks") or []])
+                                    rec.get("inlinks") or []],
+                           site=rec.get("site"),
+                           linkee_sites=rec.get("linkee_sites"))
 
 
 def remove_document(coll: Collection, url: str, _count: bool = True,
@@ -434,12 +483,16 @@ def remove_document(coll: Collection, url: str, _count: bool = True,
     # tombstone this page's outlink edges so its anchors stop feeding
     # linkee rankings (the old meta list's linkdb records, negated)
     from ..spider.linkdb import pack_key as link_key
-    edges = outlink_edges(ml, u.full)
+    edges = ml.edges
     for linkee, _anchor in edges:
-        if linkee.site == u.site:
+        # delete under the boundary FROZEN at add time (stored in the
+        # titlerec); legacy recs without the map fall back to tagdb
+        lk_site = ml.edge_sites.get(linkee.full) \
+            or coll.tagdb.site_of(linkee)
+        if lk_site == ml.site:
             continue
         coll.linkdb.rdb.delete(
-            link_key(linkee.site, linkee.full, u.site, u.full).reshape(1))
+            link_key(lk_site, linkee.full, ml.site, u.full).reshape(1))
     if ml.words:
         coll.speller.remove_doc_words(ml.words)
     if _count:
@@ -448,11 +501,12 @@ def remove_document(coll: Collection, url: str, _count: bool = True,
     if propagate:
         # former linkees lose this page's anchor — refresh them
         refresh_linkees(
-            ml.refresh_targets, u.site,
+            ml.refresh_targets, ml.site,
             get_doc=lambda lk: get_document(coll, url=lk.full),
             linkdb_of=lambda _site: coll.linkdb,
             reindex=lambda lk, _rec: reindex_document(
-                coll, lk.full, propagate=False))
+                coll, lk.full, propagate=False),
+            site_of=coll.tagdb.site_of)
     return ml
 
 

@@ -76,6 +76,8 @@ class Collection:
                                      clusterdb.KEY_DTYPE)
         from ..spider.linkdb import Linkdb
         self.linkdb = Linkdb(self.dir)
+        from .tagdb import Tagdb
+        self.tagdb = Tagdb(self.dir)
         from ..query.speller import Speller
         self.speller = Speller(self.dir)
         self._stats_path = self.dir / "collstats.json"
@@ -111,14 +113,14 @@ class Collection:
 
     def save(self) -> None:
         for db in (self.posdb, self.titledb, self.clusterdb,
-                   self.linkdb.rdb):
+                   self.linkdb.rdb, self.tagdb.rdb):
             db.save()
         self.speller.save()
         self._save_stats()
 
     def dump_all(self) -> None:
         for db in (self.posdb, self.titledb, self.clusterdb,
-                   self.linkdb.rdb):
+                   self.linkdb.rdb, self.tagdb.rdb):
             db.dump()
         self._save_stats()
 

@@ -180,6 +180,10 @@ class ShardNodeServer:
                 ml = docproc.index_document(
                     self.coll, payload["url"], payload["content"])
                 self._maybe_checkpoint()
+                if ml is None:  # tagdb manualban — the DELIVERY
+                    # succeeded (ok), the document was refused; ok=False
+                    # would park the write and wedge the ordered queue
+                    return {"ok": True, "banned": True}
                 return {"ok": True, "docid": int(ml.docid)}
             if path == "/rpc/remove":
                 self._journal_write({"op": "remove",

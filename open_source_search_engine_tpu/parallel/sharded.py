@@ -37,6 +37,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..build import docproc
 from ..index import posdb
 from ..index.collection import Collection
+from ..index.tagdb import Tagdb
 from ..query import weights
 from ..query.compiler import QueryPlan, compile_query
 from ..query.engine import SearchResults, build_results
@@ -54,6 +55,41 @@ def _docid_of(url: str) -> int:
     from ..utils import ghash
     from ..utils.url import normalize
     return ghash.doc_id(normalize(url).full)
+
+
+class ShardedTagdb(Tagdb):
+    """Tag records routed by sitehash to their owning shard — the
+    reference shards tagdb like any Rdb (``Tagdb.h:323``), and TagRec
+    probes each candidate container site on that site's own shard.
+    Writes fan out to every twin (Msg1 semantics); reads hit the
+    serving replica. The container-walk logic (get_tag / tag_rec /
+    site_of / index_gate) is inherited unchanged."""
+
+    def __init__(self, sc: "ShardedCollection"):
+        self._sc = sc  # no local Rdb — per-site routing below
+
+    @property
+    def empty(self) -> bool:
+        return all(c.tagdb.empty for row in self._sc.grid for c in row)
+
+    def _shard_of(self, site: str) -> int:
+        return int(self._sc.hostmap.shard_of_site(site))
+
+    def set_tag(self, site: str, name: str, value,
+                user: str = "admin") -> None:
+        for c in self._sc.replicas_of(self._shard_of(site)):
+            c.tagdb.set_tag(site, name, value, user)
+
+    def remove_tag(self, site: str, name: str) -> None:
+        for c in self._sc.replicas_of(self._shard_of(site)):
+            c.tagdb.remove_tag(site, name)
+
+    def tags_for_site(self, site: str) -> dict[str, object]:
+        return self._sc.shards[self._shard_of(site)].tagdb \
+            .tags_for_site(site)
+
+    def save(self) -> None:  # per-shard Collections save their own
+        pass
 
 
 class ShardedCollection:
@@ -81,6 +117,8 @@ class ShardedCollection:
         #: monotonic corpus mutation counter (invalidates merged-view
         #: caches even when a replace leaves num_docs unchanged)
         self.mutations = 0
+        #: site-routed tag store (bans / boundaries / overrides)
+        self.tagdb = ShardedTagdb(self)
 
     @property
     def n_shards(self) -> int:
@@ -126,13 +164,22 @@ class ShardedCollection:
         termid shard, titledb+clusterdb to the docid's shard, linkdb
         edges to the linkee site's shard)."""
         from ..utils.url import normalize
+        u = normalize(url)
+        # tagdb gate (XmlDoc::indexDoc EDOCBANNED + SiteGetter boundary
+        # + siterank override) — same semantics as the single-node path
+        banned, site, sr_override = self.tagdb.index_gate(u)
+        if banned:
+            self.remove_document(url, propagate=propagate)
+            return None
+        if sr_override is not None:
+            siterank = sr_override
         self.mutations += 1
         old = self.remove_document(url, propagate=False)
-        u = normalize(url)
-        inlinks = self._linkdb_of(u.site).inlinks_for_url(u.site, u.full)
+        inlinks = self._linkdb_of(site).inlinks_for_url(site, u.full)
         ml = docproc.build_meta_list(url, content, is_html=is_html,
                                      siterank=siterank, langid=langid,
-                                     inlinks=inlinks)
+                                     inlinks=inlinks, site=site,
+                                     site_resolver=self.tagdb.site_of)
         home = int(self.hostmap.shard_of_docid(ml.docid))
         key_shards = self.hostmap.shard_of_keys(ml.posdb_keys)
         # every record goes to ALL twins of its owning shard (the Msg1
@@ -149,17 +196,18 @@ class ShardedCollection:
                 coll.speller.add_doc_words(ml.words)
         # outlink edges → linkee-site shards; refresh affected linkees
         # (shared propagate step, including the old version's linkees)
-        edges = docproc.outlink_edges(ml, u.full)
+        edges = ml.edges
         for linkee, anchor in edges:
-            for ldb in self._linkdbs_all(linkee.site):
+            lk_site = ml.edge_sites.get(linkee.full, linkee.site)
+            for ldb in self._linkdbs_all(lk_site):
                 ldb.add_link(
-                    linkee.site, u.site, u.full, linkee_url=linkee.full,
+                    lk_site, site, u.full, linkee_url=linkee.full,
                     anchor_text=anchor, linker_siterank=siterank)
         ml.refresh_targets = [e[0] for e in edges]
         if old:
             ml.refresh_targets += old.refresh_targets
         if propagate:
-            self._refresh_linkees(ml.refresh_targets, u.site)
+            self._refresh_linkees(ml.refresh_targets, site)
         return ml
 
     def _refresh_linkees(self, linkees, own_site: str) -> None:
@@ -174,8 +222,10 @@ class ShardedCollection:
             reindex=lambda lk, rec: self.index_document(
                 lk.full, rec.get("content", rec["text"]),
                 is_html=rec.get("is_html", True),
-                siterank=site_rank(self.site_num_inlinks(lk.site)),
-                langid=rec.get("langid"), propagate=False))
+                siterank=site_rank(self.site_num_inlinks(
+                    self.tagdb.site_of(lk))),
+                langid=rec.get("langid"), propagate=False),
+            site_of=self.tagdb.site_of)
 
     def remove_document(self, url: str, propagate: bool = True):
         from ..spider.linkdb import pack_key as link_key
@@ -200,17 +250,20 @@ class ShardedCollection:
                 coll.speller.remove_doc_words(dead.words)
             coll.doc_removed()
         u = normalize(url)
-        edges = docproc.outlink_edges(dead, u.full)
+        edges = dead.edges
         for linkee, _anchor in edges:
-            if linkee.site == u.site:
+            # delete under the boundary frozen at add time (titlerec map)
+            lk_site = dead.edge_sites.get(linkee.full) \
+                or self.tagdb.site_of(linkee)
+            if lk_site == dead.site:
                 continue
-            for ldb in self._linkdbs_all(linkee.site):
+            for ldb in self._linkdbs_all(lk_site):
                 ldb.rdb.delete(
-                    link_key(linkee.site, linkee.full, u.site,
+                    link_key(lk_site, linkee.full, dead.site,
                              u.full).reshape(1))
         dead.refresh_targets = [e[0] for e in edges]
         if propagate:
-            self._refresh_linkees(dead.refresh_targets, u.site)
+            self._refresh_linkees(dead.refresh_targets, dead.site)
         return dead
 
     def get_document(self, docid: int) -> dict | None:

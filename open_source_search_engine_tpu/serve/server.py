@@ -213,6 +213,9 @@ class SearchHTTPServer:
             ml = self.sharded.index_document(url, content)
         else:
             ml = docproc.index_document(self._coll(query), url, content)
+        if ml is None:  # tagdb manualban (EDOCBANNED)
+            return 403, json.dumps({"error": "banned by tagdb"}), \
+                "application/json"
         return 200, json.dumps({"docId": ml.docid,
                                 "numKeys": len(ml.posdb_keys)}), \
             "application/json"

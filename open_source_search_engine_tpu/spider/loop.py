@@ -47,13 +47,18 @@ class SpiderLoop:
         # `scheduler or ...` would discard an EMPTY scheduler (len()==0
         # makes it falsy) — a durable frontier always starts empty
         self.sched = scheduler if scheduler is not None \
-            else SpiderScheduler()
+            else SpiderScheduler(banned=self._tagdb_banned)
         self.fetcher = fetcher or Fetcher()
         self.batch_size = batch_size
         self.stats = CrawlStats()
 
     def add_url(self, url: str) -> bool:
         return self.sched.add_url(url)
+
+    def _tagdb_banned(self, url: str) -> bool:
+        """Frontier ban gate (tagdb manualban, urlfilters semantics)."""
+        tagdb = getattr(self.target, "tagdb", None)
+        return tagdb.is_banned(url) if tagdb is not None else False
 
     def _site_num_inlinks(self, site: str) -> int:
         if hasattr(self.target, "site_num_inlinks"):  # ShardedCollection
@@ -102,6 +107,9 @@ class SpiderLoop:
                 continue
             try:
                 ml = self._index(res.url, res.content, res.is_html)
+                if ml is None:  # tagdb manualban (EDOCBANNED)
+                    self.stats.errors += 1
+                    continue
                 indexed += 1
                 self.stats.indexed += 1
             except Exception as e:  # noqa: BLE001

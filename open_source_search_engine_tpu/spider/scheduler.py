@@ -74,10 +74,15 @@ class SpiderScheduler:
     """Frontier + politeness + dedup (spiderdb/doledb/waiting-tree)."""
 
     def __init__(self, filters: list[UrlFilterRule] | None = None,
-                 max_hops: int = 3, same_host_only: bool = False):
+                 max_hops: int = 3, same_host_only: bool = False,
+                 banned=None):
         self.filters = filters or list(DEFAULT_FILTERS)
         self.max_hops = max_hops
         self.same_host_only = same_host_only
+        #: optional url → bool hook, normally Tagdb.is_banned — banned
+        #: sites never enter the frontier (the reference's urlfilters
+        #: consult tagdb's manualban before doling)
+        self.banned = banned
         self.seen: set[int] = set()          # urlhash48 (spider replies)
         self.heap: list[_Doled] = []         # doledb
         self.host_ready_at: dict[str, float] = {}  # per-host politeness
@@ -102,6 +107,8 @@ class SpiderScheduler:
             return False
         rule = self._rule_for(u.full)
         if rule is None or not rule.allow:
+            return False
+        if self.banned is not None and self.banned(u.full):
             return False
         cap = rule.max_hops if rule.max_hops is not None else self.max_hops
         if hopcount > cap:

@@ -185,9 +185,10 @@ class DurableSpiderScheduler(SpiderScheduler):
 
     def __init__(self, directory: str | Path,
                  filters: list[UrlFilterRule] | None = None,
-                 max_hops: int = 3, same_host_only: bool = False):
+                 max_hops: int = 3, same_host_only: bool = False,
+                 banned=None):
         super().__init__(filters=filters, max_hops=max_hops,
-                         same_host_only=same_host_only)
+                         same_host_only=same_host_only, banned=banned)
         self.db = SpiderDb(directory)
         pending, seen = self.db.load()
         #: url identities already in spiderdb (63-bit key hash — the

@@ -1,0 +1,190 @@
+"""Tagdb + SiteGetter tests.
+
+Reference behaviors pinned (``Tagdb.h:323``, ``SiteGetter.cpp``):
+tag set/get/remove with newest-wins replacement; TagRec container walk
+(subdirectory site → host → registrable domain); ``manualban`` blocks
+indexing and the frontier; ``sitepathdepth`` widens the site boundary so
+user directories on a hosting host cluster as distinct sites; restart
+persistence rides the normal Rdb save/load path.
+"""
+
+import numpy as np
+import pytest
+
+from open_source_search_engine_tpu.build import docproc
+from open_source_search_engine_tpu.index import clusterdb
+from open_source_search_engine_tpu.index.collection import Collection
+from open_source_search_engine_tpu.index.tagdb import Tagdb
+from open_source_search_engine_tpu.query import engine
+from open_source_search_engine_tpu.spider.scheduler import SpiderScheduler
+from open_source_search_engine_tpu.utils import ghash
+
+
+@pytest.fixture
+def coll(tmp_path):
+    return Collection("t", tmp_path)
+
+
+def test_set_get_remove_roundtrip(tmp_path):
+    td = Tagdb(tmp_path)
+    assert td.empty
+    td.set_tag("example.com", "note", "seed site")
+    assert not td.empty
+    assert td.tags_for_site("example.com") == {"note": "seed site"}
+    td.set_tag("example.com", "note", "updated")  # newest wins
+    assert td.tags_for_site("example.com")["note"] == "updated"
+    td.remove_tag("example.com", "note")
+    assert td.tags_for_site("example.com") == {}
+
+
+def test_tag_rec_container_walk(tmp_path):
+    td = Tagdb(tmp_path)
+    td.set_tag("example.co.uk", "a", "domain")
+    td.set_tag("www.example.co.uk", "a", "host")
+    td.set_tag("www.example.co.uk", "b", "host-only")
+    # narrowest container wins for a, domain fills in the rest
+    assert td.get_tag("http://www.example.co.uk/x", "a") == "host"
+    assert td.get_tag("http://other.example.co.uk/x", "a") == "domain"
+    rec = td.tag_rec("http://www.example.co.uk/p")
+    assert rec == {"a": "host", "b": "host-only"}
+
+
+def test_site_of_path_depth(tmp_path):
+    td = Tagdb(tmp_path)
+    assert td.site_of("http://users.example.com/~alice/page.html") == \
+        "users.example.com"
+    td.set_tag("users.example.com", "sitepathdepth", 1)
+    assert td.site_of("http://users.example.com/~alice/page.html") == \
+        "users.example.com/~alice/"
+    assert td.site_of("http://users.example.com/~bob/") == \
+        "users.example.com/~bob/"
+    assert td.site_of("http://users.example.com/") == "users.example.com"
+    # a trailing FILENAME segment never counts as a site directory
+    # (SiteGetter truncates at directory boundaries)
+    assert td.site_of("http://users.example.com/page.html") == \
+        "users.example.com"
+    # index_gate returns the same answers in one walk
+    from open_source_search_engine_tpu.utils.url import normalize
+    u = normalize("http://users.example.com/~alice/page.html")
+    assert td.index_gate(u) == (False, "users.example.com/~alice/", None)
+
+
+def test_persistence(tmp_path):
+    td = Tagdb(tmp_path)
+    td.set_tag("example.com", "manualban", 1)
+    td.save()
+    td2 = Tagdb(tmp_path)
+    assert td2.is_banned("http://spam.example.com/page")
+    assert not td2.is_banned("http://clean.org/")
+
+
+def test_manualban_blocks_indexing_and_removes(coll):
+    html = "<html><title>spam</title><body>buy pills now</body></html>"
+    ml = docproc.index_document(coll, "http://spam.test/p", html)
+    assert ml is not None and coll.num_docs == 1
+    coll.tagdb.set_tag("spam.test", "manualban", 1)
+    # re-injection is refused AND the existing doc is dropped
+    assert docproc.index_document(coll, "http://spam.test/p", html) is None
+    assert coll.num_docs == 0
+    assert docproc.get_document(coll, url="http://spam.test/p") is None
+    r = engine.search(coll, "pills")
+    assert r.total_matches == 0
+
+
+def test_manualban_blocks_frontier(coll):
+    coll.tagdb.set_tag("spam.test", "manualban", 1)
+    sched = SpiderScheduler(banned=coll.tagdb.is_banned)
+    assert not sched.add_url("http://spam.test/x")
+    assert sched.add_url("http://ok.test/x")
+
+
+def test_siterank_override(coll):
+    coll.tagdb.set_tag("boosted.test", "siterank", 9)
+    ml = docproc.index_document(
+        coll, "http://boosted.test/p",
+        "<html><title>t</title><body>boosted words</body></html>")
+    from open_source_search_engine_tpu.index import posdb
+    f = posdb.unpack(ml.posdb_keys)
+    assert (f["siterank"] == 9).all()
+
+
+def test_sitepathdepth_clusters_user_dirs_separately(coll):
+    """Two user dirs on one host = two sites: distinct clusterdb
+    sitehashes, and site clustering no longer folds them together."""
+    coll.tagdb.set_tag("users.test", "sitepathdepth", 1)
+    mls = []
+    for user in ("alice", "bob"):
+        for i in range(3):
+            mls.append(docproc.index_document(
+                coll, f"http://users.test/~{user}/p{i}",
+                f"<html><title>{user} {i}</title><body>"
+                f"<p>shared topic words plus {user} page number{i}.</p>"
+                "</body></html>"))
+    sites = {ml.site for ml in mls}
+    assert sites == {"users.test/~alice/", "users.test/~bob/"}
+    hashes = {int(clusterdb.unpack_key(
+        ml.clusterdb_key.reshape(1))["sitehash"][0]) for ml in mls}
+    assert len(hashes) == 2
+    # site: fielded search honors the boundary (all 3 match; site
+    # clustering then hides the third — one site, MAX_PER_SITE=2)
+    r = engine.search(coll, "site:users.test/~alice/ topic")
+    assert r.total_matches == 3 and len(r.results) == 2 \
+        and r.clustered == 1
+    assert all(res.url.startswith("http://users.test/~alice/")
+               for res in r.results)
+    # clustering keeps MAX_PER_SITE per user dir, not per host
+    r2 = engine.search(coll, "shared topic")
+    assert len(r2.results) == 4  # 2 per site × 2 sites
+    # tombstones regenerate with the stored boundary: removal is clean
+    docproc.remove_document(coll, "http://users.test/~alice/p0")
+    r3 = engine.search(coll, "site:users.test/~alice/ topic")
+    assert {res.url for res in r3.results} == {
+        f"http://users.test/~alice/p{i}" for i in (1, 2)}
+
+
+def test_sharded_tagdb_ban_and_boundary(tmp_path):
+    """The sharded path honors the same tagdb semantics: tags route to
+    the site's owning shard; bans refuse sharded injects; boundaries
+    flow into the sharded clusterdb records."""
+    from open_source_search_engine_tpu.parallel.sharded import \
+        ShardedCollection
+    sc = ShardedCollection("t", tmp_path, n_shards=2)
+    sc.tagdb.set_tag("spam.test", "manualban", 1)
+    sc.tagdb.set_tag("users.test", "sitepathdepth", 1)
+    assert sc.index_document(
+        "http://spam.test/p",
+        "<html><title>x</title><body>junk</body></html>") is None
+    assert sc.num_docs == 0
+    ml = sc.index_document(
+        "http://users.test/~alice/p0",
+        "<html><title>a</title><body>alpha words</body></html>")
+    assert ml is not None and ml.site == "users.test/~alice/"
+    # removal tombstones cleanly under the frozen boundary
+    assert sc.remove_document("http://users.test/~alice/p0") is not None
+    assert sc.num_docs == 0
+
+
+def test_cluster_rpc_banned_does_not_wedge_writes(tmp_path):
+    """A banned inject must ACK (ok) at the RPC layer, or the ordered
+    per-host write queue would retry it forever and block every
+    subsequent write to that shard."""
+    from open_source_search_engine_tpu.parallel.cluster import \
+        ShardNodeServer
+    node = ShardNodeServer(tmp_path)
+    node.coll.tagdb.set_tag("spam.test", "manualban", 1)
+    out = node.handle("/rpc/index",
+                      {"url": "http://spam.test/p", "content": "<p>x</p>"})
+    assert out["ok"] is True and out.get("banned") is True
+    out2 = node.handle("/rpc/index",
+                       {"url": "http://ok.test/p",
+                        "content": "<html><body>fine</body></html>"})
+    assert out2["ok"] is True and "docid" in out2
+
+
+def test_shard_of_tagdb_keys_is_sitehash_stable(tmp_path):
+    """Tagdb keys carry the sitehash in n1 so a future sharded tagdb
+    routes by site like linkdb routes by linkee site."""
+    from open_source_search_engine_tpu.index.tagdb import pack_key
+    k1 = pack_key("example.com", "a")
+    k2 = pack_key("example.com", "b")
+    assert int(k1["n1"]) == int(k2["n1"]) == ghash.hash64("example.com")
