@@ -123,6 +123,9 @@ def main() -> None:
     warm_s = time.perf_counter() - t0
 
     # --- measured: batched throughput over unique queries ---
+    if os.environ.get("BENCH_STATS"):
+        from open_source_search_engine_tpu.utils.stats import g_stats
+        g_stats.reset()  # timers cover ONLY the measured pass
     esc0 = di.escalations
     t0 = time.perf_counter()
     for i in range(0, len(meas_qs), BATCH):
@@ -148,6 +151,13 @@ def main() -> None:
         "p50_ms": round(p50, 1),
         "docs": N_DOCS,
     }))
+    if os.environ.get("BENCH_STATS"):
+        from open_source_search_engine_tpu.utils.stats import g_stats
+        snap = g_stats.snapshot()
+        for k, v in sorted(snap.get("latencies", {}).items()):
+            print(f"# {k}: n={v['count']} avg={v['avg_ms']:.1f} "
+                  f"min={v['min_ms']:.1f} max={v['max_ms']:.1f}",
+                  file=sys.stderr)
     print(f"# corpus={N_DOCS} docs ({build_s:.0f}s build, "
           f"{N_DOCS / max(build_s, 1e-9):.0f} docs/s; device build "
           f"{device_build_s:.1f}s), warmup {warm_s:.0f}s, "

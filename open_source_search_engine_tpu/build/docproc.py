@@ -298,8 +298,6 @@ def resolve_links(links: list[tuple[str, str]], linker_url: str):
     return out
 
 
-def outlink_edges(ml: MetaList, linker_url: str):
-    return ml.edges or resolve_links(ml.links, linker_url)
 
 
 def needs_link_refresh(fresh: list, stored: list) -> bool:

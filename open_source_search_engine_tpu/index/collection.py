@@ -94,6 +94,13 @@ class Collection:
         #: LRU-bounded by total key bytes.
         self.termlist_cache = TermlistCache()
 
+    def rdbs(self) -> dict[str, "rdblite.Rdb"]:
+        """Every named Rdb this collection owns (the per-coll RdbBase
+        set, ``Collectiondb.h:39``) — repair/resync/scrub iterate this."""
+        return {"posdb": self.posdb, "titledb": self.titledb,
+                "clusterdb": self.clusterdb, "linkdb": self.linkdb.rdb,
+                "tagdb": self.tagdb.rdb}
+
     # --- stats used by ranking ---
 
     def _load_stats(self) -> None:

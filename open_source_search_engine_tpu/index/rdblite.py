@@ -49,6 +49,38 @@ log = get_logger("rdb")
 PAGE_KEYS = 4096
 
 
+class CorruptRunError(Exception):
+    """A run failed its integrity checks (Msg5.h:50 'Rdb Error
+    Correction' — the reference detects out-of-order keys / bad maps at
+    read time and patches the list from a twin)."""
+
+
+def _crc_chunks(arr: np.ndarray, chunk_rows: int = 1 << 22) -> int:
+    """CRC32 of an array's bytes, streamed row-chunk-wise so an mmap'd
+    multi-GB run never materializes whole in RAM."""
+    import zlib
+    crc = 0
+    for i in range(0, len(arr), chunk_rows):
+        crc = zlib.crc32(
+            np.ascontiguousarray(arr[i:i + chunk_rows]).tobytes(), crc)
+    return crc
+
+
+def keys_sorted(keys: np.ndarray) -> bool:
+    """Vectorized adjacent-pair sortedness check in key-compare order
+    (reversed declared fields) — the reference's checkList_r symptom
+    for corruption is exactly out-of-order keys."""
+    if len(keys) < 2:
+        return True
+    violated = np.zeros(len(keys) - 1, bool)
+    decided = np.zeros(len(keys) - 1, bool)
+    for f in reversed(keys.dtype.names):  # most significant first
+        a, b = keys[f][:-1], keys[f][1:]
+        violated |= (~decided) & (a > b)
+        decided |= a != b
+    return not violated.any()
+
+
 # ---------------------------------------------------------------------------
 # key-array helpers (generic over structured key dtypes)
 # ---------------------------------------------------------------------------
@@ -275,15 +307,49 @@ class Run:
     now that reads go through mmap+searchsorted).
     """
 
-    def __init__(self, path: Path):
+    def __init__(self, path: Path, verify: bool = True):
         self.path = Path(path)
-        self.meta = json.loads((self.path / "meta.json").read_text())
-        self.keys = np.load(self.path / "keys.npy", mmap_mode="r")
-        self.offsets = None
-        self.data = None
-        if (self.path / "offsets.npy").exists():
-            self.offsets = np.load(self.path / "offsets.npy", mmap_mode="r")
-            self.data = np.load(self.path / "data.npy", mmap_mode="r")
+        try:
+            self.meta = json.loads((self.path / "meta.json").read_text())
+            self.keys = np.load(self.path / "keys.npy", mmap_mode="r")
+            self.offsets = None
+            self.data = None
+            if (self.path / "offsets.npy").exists():
+                self.offsets = np.load(self.path / "offsets.npy",
+                                       mmap_mode="r")
+                self.data = np.load(self.path / "data.npy", mmap_mode="r")
+        except Exception as e:  # torn write, missing file, bad header
+            raise CorruptRunError(f"{path}: unreadable ({e})") from e
+        if verify:
+            self.verify()
+
+    def verify(self) -> None:
+        """Integrity check (the Msg5/RdbMap corruption detection):
+        record count, key order, offset monotonicity, and — when the
+        run was written with them — whole-file CRCs streamed in bounded
+        chunks (no 2×-file-size allocation). Raises
+        :class:`CorruptRunError`; the Rdb quarantines such runs and a
+        twin patches them back (``developer.html`` 'Rdb Error
+        Correction')."""
+        if self.meta.get("nrecs") != len(self.keys):
+            raise CorruptRunError(
+                f"{self.path}: nrecs {self.meta.get('nrecs')} != "
+                f"{len(self.keys)}")
+        if not keys_sorted(self.keys):
+            raise CorruptRunError(f"{self.path}: keys out of order")
+        if self.offsets is not None:
+            offs = np.asarray(self.offsets)
+            if len(offs) != len(self.keys) + 1 or offs[0] != 0 \
+                    or (np.diff(offs) < 0).any() \
+                    or offs[-1] > len(self.data):
+                raise CorruptRunError(f"{self.path}: bad offsets")
+        crc = self.meta.get("keys_crc")
+        if crc is not None and _crc_chunks(self.keys) != crc:
+            raise CorruptRunError(f"{self.path}: keys CRC mismatch")
+        dcrc = self.meta.get("data_crc")
+        if dcrc is not None and self.data is not None \
+                and _crc_chunks(self.data) != dcrc:
+            raise CorruptRunError(f"{self.path}: data CRC mismatch")
 
     def __len__(self) -> int:
         return len(self.keys)
@@ -299,10 +365,13 @@ class Run:
         if tmp.exists():
             shutil.rmtree(tmp)
         tmp.mkdir(parents=True)
-        np.save(tmp / "keys.npy", np.ascontiguousarray(batch.keys))
+        keys_c = np.ascontiguousarray(batch.keys)
+        np.save(tmp / "keys.npy", keys_c)
+        data_crc = None
         if batch.has_data:
             np.save(tmp / "offsets.npy", batch.offsets)
             np.save(tmp / "data.npy", batch.data)
+            data_crc = _crc_chunks(batch.data)
         page_firsts = [
             [int(batch.keys[i][f]) for f in batch.keys.dtype.names]
             for i in range(0, len(batch), PAGE_KEYS)
@@ -313,9 +382,13 @@ class Run:
             "has_data": batch.has_data,
             "page_keys": PAGE_KEYS,
             "page_first_keys": page_firsts,
+            # whole-file CRCs (the RdbMap's integrity role): verified at
+            # load; a mismatch quarantines the run for twin patching
+            "keys_crc": _crc_chunks(keys_c),
+            "data_crc": data_crc,
         }))
         tmp.rename(path)  # atomic publish
-        return Run(path)
+        return Run(path, verify=False)  # just written from RAM
 
 
 # ---------------------------------------------------------------------------
@@ -435,6 +508,9 @@ class Rdb:
         self.max_runs = max_runs
         self.mem = MemTable(key_dtype, has_data)
         self.runs: list[Run] = []
+        #: names of runs quarantined at load (corrupt — healed by
+        #: :meth:`resync` / twin patching; surfaced on /admin/stats)
+        self.quarantined: list[str] = []
         self._next_run_id = 0
         #: bumped on every mutation; device-resident mirrors compare it
         #: to know when to repack (the Rdb dump/merge → repack cycle)
@@ -535,6 +611,45 @@ class Rdb:
         log.debug("%s: merged %d newest runs -> %s (%d recs, %d kept)",
                   self.name, len(old), run.path.name, len(run), start)
 
+    def scrub(self) -> list[str]:
+        """Re-verify every loaded run NOW; quarantine failures (the
+        admin-triggered integrity sweep — load-time verification only
+        catches corruption present at startup). Returns quarantined
+        run names; the caller heals them from a twin."""
+        bad: list[str] = []
+        keep: list[Run] = []
+        for r in self.runs:
+            try:
+                r.verify()
+                keep.append(r)
+            except CorruptRunError as e:
+                q = r.path.with_name(r.path.name + ".corrupt")
+                if q.exists():
+                    shutil.rmtree(q)
+                r.path.rename(q)
+                self.quarantined.append(q.name)
+                bad.append(q.name)
+                log.error("%s: QUARANTINED corrupt run: %s",
+                          self.name, e)
+        if bad:
+            self.runs = keep
+            self.version += 1
+        return bad
+
+    def replace_with(self, batch: RecordBatch) -> None:
+        """Wipe and reload from one merged batch — the twin-patch
+        receive side (Msg5 error correction's 'get the list from the
+        twin and use it instead')."""
+        self.wipe()
+        self.quarantined = []
+        for p in self.dir.glob("run_*.corrupt"):
+            shutil.rmtree(p, ignore_errors=True)
+        if len(batch):
+            self.mem.add(batch.keys.copy(),
+                         batch.payloads() if self.has_data else None)
+            self.dump()
+        self.version += 1
+
     # --- reads (Msg5 semantics) ---
 
     def get_list(self, start_key: np.ndarray, end_key: np.ndarray) -> RecordBatch:
@@ -568,15 +683,29 @@ class Rdb:
 
     def _load_existing_runs(self) -> None:
         for p in sorted(self.dir.glob("run_*")):
-            if p.is_dir() and not p.name.endswith(".tmp"):
-                self.runs.append(Run(p))
-                parts = p.name.split("_")
+            if not p.is_dir() or p.name.endswith(".tmp") \
+                    or p.name.endswith(".corrupt"):
+                continue
+            parts = p.name.split("_")
+            self._next_run_id = max(self._next_run_id,
+                                    int(parts[1]) + 1)
+            if len(parts) > 2 and parts[2].startswith("m"):
+                # merged runs carry the id counter in the _m suffix:
+                # it must survive restarts or the next merge reuses
+                # a live name
                 self._next_run_id = max(self._next_run_id,
-                                        int(parts[1]) + 1)
-                if len(parts) > 2 and parts[2].startswith("m"):
-                    # merged runs carry the id counter in the _m suffix:
-                    # it must survive restarts or the next merge reuses
-                    # a live name
-                    self._next_run_id = max(self._next_run_id,
-                                            int(parts[2][1:]) + 1)
+                                        int(parts[2][1:]) + 1)
+            try:
+                self.runs.append(Run(p))
+            except CorruptRunError as e:
+                # quarantine, serve what remains, heal from a twin
+                # (Msg5 error correction; the reference likewise drops
+                # unreadable lists and patches from the twin host)
+                q = p.with_name(p.name + ".corrupt")
+                if q.exists():
+                    shutil.rmtree(q)
+                p.rename(q)
+                self.quarantined.append(q.name)
+                log.error("%s: QUARANTINED corrupt run: %s",
+                          self.name, e)
         self.load_saved()

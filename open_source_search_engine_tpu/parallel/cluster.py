@@ -211,7 +211,69 @@ class ShardNodeServer:
             if path == "/rpc/save":
                 self.save()
                 return {"ok": True}
+            if path == "/rpc/pull":
+                # twin-patch send side (Msg5 error correction): ship one
+                # Rdb's full merged content to a healing sibling
+                name = payload["name"]
+                if name == "speller":
+                    return {"ok": True,
+                            "counts": dict(self.coll.speller.counts)}
+                rdb = self.coll.rdbs().get(name)
+                if rdb is None:
+                    return {"ok": False, "error": f"no rdb {name}"}
+                return {"ok": True, "batch": _encode_batch(rdb.get_all()),
+                        "num_docs": self.coll.num_docs}
+            if path == "/rpc/heal":
+                n = self.heal_from(payload["from"])
+                return {"ok": True, "healed_rdbs": n}
         raise KeyError(path)
+
+    def scrub(self) -> list[str]:
+        """Integrity sweep over this node's Rdbs (quarantines corrupt
+        runs; the operator heals via /rpc/heal from a twin)."""
+        with self._lock:
+            return [f"{name}/{run}"
+                    for name, rdb in self.coll.rdbs().items()
+                    for run in rdb.scrub()]
+
+    def heal_from(self, addr: str) -> int:
+        """Twin-patch receive side: replace every local Rdb with the
+        sibling's content (also the recovered-twin catch-up — a node
+        that was dead while writes flowed rejoins consistent).
+
+        ALL pulls complete before anything local is touched: a sibling
+        dying mid-heal must not leave this node with mixed Rdb
+        generations (posdb from the twin, titledb from before)."""
+        pulled: dict[str, dict] = {}
+        try:
+            for name in self.coll.rdbs():
+                out = _rpc(addr, "/rpc/pull", {"name": name},
+                           timeout=120.0)
+                if not out.get("ok"):
+                    raise RuntimeError(
+                        f"pull {name}: {out.get('error', 'not ok')}")
+                pulled[name] = out
+            sp = _rpc(addr, "/rpc/pull", {"name": "speller"},
+                      timeout=120.0)
+        except Exception as e:  # noqa: BLE001 — transport/sibling death
+            log.error("heal from %s aborted before applying: %s",
+                      addr, e)
+            return 0
+        with self._lock:
+            num_docs = self.coll.num_docs
+            for name, rdb in self.coll.rdbs().items():
+                rdb.replace_with(_decode_batch(pulled[name]["batch"]))
+                num_docs = pulled[name].get("num_docs", num_docs)
+            self.coll.num_docs = num_docs
+            if sp.get("ok"):
+                from collections import defaultdict
+                self.coll.speller.counts = defaultdict(
+                    int, sp["counts"])
+                self.coll.speller._len_index = None
+            self.coll.titlerec_cache.clear()
+            self.coll._save_stats()
+            log.info("healed %d rdbs from %s", len(pulled), addr)
+            return len(pulled)
 
     def save(self) -> None:
         """Checkpoint under the writer lock; the saved state supersedes
@@ -278,6 +340,34 @@ class ShardNodeServer:
 # ---------------------------------------------------------------------------
 # client side (Msg1 writes / Msg0+Multicast reads / Msg3a merge)
 # ---------------------------------------------------------------------------
+
+def _encode_batch(batch) -> dict:
+    """RecordBatch → JSON-safe dict (base64 .npy images). The twin
+    patch ships whole Rdbs; base64-over-JSON costs 33% wire overhead —
+    acceptable for a repair path that runs on corruption, not queries."""
+    import base64
+    import io
+    out = {}
+    for nm, arr in (("keys", np.ascontiguousarray(batch.keys)),
+                    ("offsets", batch.offsets), ("data", batch.data)):
+        if arr is None:
+            continue
+        bio = io.BytesIO()
+        np.save(bio, np.ascontiguousarray(arr))
+        out[nm] = base64.b64encode(bio.getvalue()).decode()
+    return out
+
+
+def _decode_batch(d: dict):
+    import base64
+    import io
+
+    from ..index.rdblite import RecordBatch
+    arrs = {nm: np.load(io.BytesIO(base64.b64decode(v)))
+            for nm, v in d.items()}
+    return RecordBatch(arrs["keys"], arrs.get("offsets"),
+                       arrs.get("data"))
+
 
 def _rpc(addr: str, path: str, payload: dict,
          timeout: float = RPC_TIMEOUT_S) -> dict:

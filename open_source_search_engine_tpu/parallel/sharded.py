@@ -271,6 +271,56 @@ class ShardedCollection:
         home = int(self.hostmap.shard_of_docid(docid))
         return docproc.get_document(self.shards[home], docid=docid)
 
+    # --- twin patching / replica resync (Msg5 error correction +
+    # recovered-twin catch-up) ------------------------------------------
+
+    def scrub(self) -> dict[str, list[str]]:
+        """Integrity sweep over every replica's every Rdb; corrupt runs
+        are quarantined and immediately healed from a live twin."""
+        report: dict[str, list[str]] = {}
+        for s in range(self.n_shards):
+            for r, coll in enumerate(self.grid[s]):
+                for name, rdb in coll.rdbs().items():
+                    rdb.scrub()
+                # includes runs quarantined at LOAD time — a restarted
+                # node with corruption found then still needs the patch
+                bad = [f"{name}/{run}"
+                       for name, rdb in coll.rdbs().items()
+                       for run in rdb.quarantined]
+                if bad:
+                    report[f"shard{s}_r{r}"] = bad
+                    self.resync_replica(s, r)
+        return report
+
+    def resync_replica(self, shard: int, replica: int) -> bool:
+        """Rebuild one twin from a healthy sibling — both the corrupt-
+        run patch (``Msg5.h:50`` twin correction) and the recovered-
+        dead-twin catch-up the reference performs before letting a host
+        rejoin its group. Returns False when no healthy source exists."""
+        row = self.grid[shard]
+        src = None
+        for r, cand in enumerate(row):
+            if r != replica and self.hostmap.alive[shard, r]:
+                src = cand
+                break
+        if src is None:
+            return False
+        dst = row[replica]
+        for name, srdb in src.rdbs().items():
+            drdb = dst.rdbs()[name]
+            drdb.replace_with(srdb.get_all())
+        dst.num_docs = src.num_docs
+        dst._save_stats()
+        from collections import defaultdict
+        dst.speller.counts = defaultdict(int, src.speller.counts)
+        dst.speller._len_index = None
+        dst.titlerec_cache.clear()
+        self.mutations += 1
+        self.hostmap.mark_alive(shard, replica)
+        log.info("resynced shard %d replica %d from a twin", shard,
+                 replica)
+        return True
+
     def save(self) -> None:
         for row in self.grid:
             for c in row:

@@ -863,9 +863,13 @@ class DeviceIndex:
         the query axis). Routing: drivers with a bounded doc set use the
         two-phase pruned kernel (F1); corpus-wide drivers go to the
         full-cube exact kernel (F2) when every sublist fits it."""
+        from ..utils.stats import g_stats
+        t_plan = time.perf_counter()
         qplans = [q if isinstance(q, QueryPlan) else compile_query(q, lang)
                   for q in queries]
         plans = [self.plan(qp) for qp in qplans]
+        g_stats.record_ms("devindex.plan",
+                          1000 * (time.perf_counter() - t_plan))
         live = [i for i, p in enumerate(plans) if p.matchable]
         results = [(np.empty(0, np.uint64), np.empty(0, np.float32), 0)
                    ] * len(plans)
@@ -887,6 +891,7 @@ class DeviceIndex:
         f2_nsel = 2048
         bmax = self._f2_bmax()
         while f1 or f2:
+            t_issue = time.perf_counter()
             waves = []
             groups: dict[int, list[int]] = {}
             for i in f1:
@@ -902,7 +907,8 @@ class DeviceIndex:
                 chunk = f2[a:a + bmax]
                 waves.append(("f2", 0, chunk, self._run_batch_f2(
                     [plans[i] for i in chunk], k_req, f2_nsel)))
-            from ..utils.stats import g_stats
+            g_stats.record_ms("devindex.issue",
+                              1000 * (time.perf_counter() - t_issue))
             t_fetch = time.perf_counter()
             outs = jax.device_get([w[3] for w in waves])
             g_stats.record_ms(
@@ -1007,6 +1013,8 @@ class DeviceIndex:
         padded = [pad_plan(p) for p in plans] \
             + [pad_plan(None)] * (B - len(plans))
         args = [np.stack([p[j] for p in padded]) for j in range(19)]
+        log.debug("f1 wave: B=%d Rd=%d Rs=%d Lsp=%d kappa=%d k2=%d",
+                  B, Rd, Rs, Lsp, kappa, k2)
         # host args ride the (async) dispatch; returned WITHOUT fetching
         # — the caller fetches every wave's output in ONE device_get
         # (each separate blocking fetch costs a full ~100 ms tunnel RTT)
@@ -1058,6 +1066,8 @@ class DeviceIndex:
         padded = [pad_plan(p) for p in plans] \
             + [pad_plan(None)] * (B - len(plans))
         args = [np.stack([p[j] for p in padded]) for j in range(20)]
+        log.debug("f2 wave: B=%d Rc=%d Rp=%d Lp=%d k2=%d n_sel=%d",
+                  B, Rc, Rp, Lp, k2, n_sel)
         return _full_cube(
             self.d_payload, self.d_pdoc, self.d_pocc, self.d_cube,
             self.d_dense_rsp, self.d_siterank, self.d_doclang,

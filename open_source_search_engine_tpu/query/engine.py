@@ -13,6 +13,7 @@ memory exactly like the reference bounds RAM.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -207,6 +208,7 @@ def search_device_batch(coll: Collection, queries, *, topk: int = 10,
     with g_stats.timed("query.device_batch"):
         raw = di.search_batch(plans, topk=max(topk * 2, 64), lang=lang)
     out = []
+    t_res = time.perf_counter()
     for plan, (docids, scores, n_matched) in zip(plans, raw):
         results, clustered = build_results(
             lambda d: docproc.get_document(coll, docid=d),
@@ -217,6 +219,9 @@ def search_device_batch(coll: Collection, queries, *, topk: int = 10,
             query=plan.raw, total_matches=n_matched, results=results,
             clustered=clustered,
             suggestion=_suggest(coll, plan) if n_matched == 0 else None))
+    g_stats.record_ms(
+        "query.results_batch",
+        1000 * (time.perf_counter() - t_res))
     return out
 
 

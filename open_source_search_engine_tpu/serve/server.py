@@ -136,7 +136,23 @@ class SearchHTTPServer:
         if path == "/addurl":
             return self._page_addurl(query)
         if path == "/admin/stats":
-            return 200, json.dumps(self.stats), "application/json"
+            stats = dict(self.stats)
+            # corrupt-run quarantine state (Msg5 error correction)
+            q: dict[str, list] = {}
+            if self.sharded is not None:
+                for s, row in enumerate(self.sharded.grid):
+                    for r, coll in enumerate(row):
+                        for rn, rdb in coll.rdbs().items():
+                            if rdb.quarantined:
+                                q[f"shard{s}_r{r}:{rn}"] = rdb.quarantined
+            elif self.colldb is not None:
+                q = {f"{cn}:{rn}": rdb.quarantined
+                     for cn in self.colldb.names()
+                     for rn, rdb in self.colldb.get(cn).rdbs().items()
+                     if rdb.quarantined}
+            if q:
+                stats["quarantined_runs"] = q
+            return 200, json.dumps(stats), "application/json"
         if path == "/admin/hosts":
             return 200, self._page_hosts(), "application/json"
         if path == "/admin/perf":

@@ -91,6 +91,12 @@ class Stats:
         with self._lock:
             self.timeseries.append((time.time(), dict(metrics)))
 
+    def reset(self) -> None:
+        """Zero counters + latency histograms (bench/test isolation)."""
+        with self._lock:
+            self.counters.clear()
+            self.latencies.clear()
+
     def snapshot(self) -> dict:
         with self._lock:
             return {
