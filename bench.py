@@ -90,27 +90,38 @@ def main() -> None:
     from open_source_search_engine_tpu.index.collection import Collection
     from open_source_search_engine_tpu.query import engine
 
-    coll = Collection("bench", tempfile.mkdtemp(prefix="osse_bench_"))
+    # BENCH_DIR reuses a corpus dir across runs (indexing 100k docs is
+    # ~5 min; iterating on query-path changes shouldn't pay it again)
+    bdir = os.environ.get("BENCH_DIR") or tempfile.mkdtemp(
+        prefix="osse_bench_")
+    coll = Collection("bench", bdir)
     t0 = time.perf_counter()
-    for i, (url, html) in enumerate(_gen_docs(N_DOCS)):
-        docproc.index_document(coll, url, html)
-        if (i + 1) % 20000 == 0:
-            print(f"# indexed {i + 1}/{N_DOCS} "
-                  f"({(i + 1) / (time.perf_counter() - t0):.0f} docs/s)",
-                  file=sys.stderr)
+    if coll.num_docs < N_DOCS:
+        for i, (url, html) in enumerate(_gen_docs(N_DOCS)):
+            docproc.index_document(coll, url, html)
+            if (i + 1) % 20000 == 0:
+                print(f"# indexed {i + 1}/{N_DOCS} "
+                      f"({(i + 1) / (time.perf_counter() - t0):.0f} "
+                      "docs/s)", file=sys.stderr)
+        # dump → the measured path serves from the on-disk base (dense +
+        # cube rows built); the remaining delta stays empty
+        coll.posdb.dump()
+        coll.titledb.dump()
+        coll.save()
     build_s = time.perf_counter() - t0
-    # dump → the measured path serves from the on-disk base (dense +
-    # cube rows built); the remaining delta stays empty
-    coll.posdb.dump()
-    coll.titledb.dump()
 
     t0 = time.perf_counter()
     di = engine.get_device_index(coll)
+    di.warm()  # precompile every pinned kernel shape variant
     device_build_s = time.perf_counter() - t0
 
-    warm_qs = _make_queries(8 * BATCH + N_LAT + 8, seed=99)
-    meas_qs = _make_queries(N_QUERIES, seed=7)
-    lat_qs = _make_queries(N_LAT, seed=1234)
+    # with a reused corpus dir, salt the query seeds per run — the
+    # tunneled backend may cache identical dispatches across processes,
+    # which would fake the throughput of a repeated measurement
+    salt = os.getpid() if os.environ.get("BENCH_DIR") else 0
+    warm_qs = _make_queries(8 * BATCH + N_LAT + 8, seed=99 + salt)
+    meas_qs = _make_queries(N_QUERIES, seed=7 + salt)
+    lat_qs = _make_queries(N_LAT, seed=1234 + salt)
     # (different seeds overlap rarely; uniqueness within each set is
     # what defeats the dispatch cache — warm queries are never measured)
 

@@ -71,6 +71,26 @@ class MetaList:
     #: the add wrote, even if tagdb boundaries changed since)
     edges: list = field(default_factory=list)
     edge_sites: dict = field(default_factory=dict)
+    #: this page's section content hashes (sectiondb records) and the
+    #: subset demoted as boilerplate at build time — both stored in the
+    #: TitleRec so tombstones regenerate the exact same postings even
+    #: after the site's section votes move
+    sections: list = field(default_factory=list)
+    boiler_sections: list = field(default_factory=list)
+
+
+def doc_section_hashes(tdoc: TokenizedDoc) -> dict[int, int]:
+    """section id → 32-bit content hash (Sections.cpp section content
+    hashes): the repeatable-across-pages identity of each second-level
+    container's word content."""
+    from ..index.sectiondb import MIN_SECTION_WORDS
+    by_sid: dict[int, list[str]] = {}
+    for t in tdoc.tokens:
+        if t.section_id:
+            by_sid.setdefault(t.section_id, []).append(t.word)
+    return {sid: ghash.hash64(" ".join(ws)) & 0xFFFFFFFF
+            for sid, ws in by_sid.items()
+            if len(ws) >= MIN_SECTION_WORDS}
 
 
 def _density_ranks(hashgroups: np.ndarray, sentences: np.ndarray) -> np.ndarray:
@@ -127,6 +147,8 @@ def build_meta_list(
     site: str | None = None,
     site_resolver=None,
     linkee_sites: dict | None = None,
+    tdoc: TokenizedDoc | None = None,
+    boiler_sections: list | None = None,
 ) -> MetaList:
     """Compute every record one document contributes. ``delete=True``
     produces the same records as tombstones (reference: the old doc's
@@ -149,12 +171,15 @@ def build_meta_list(
     u = normalize(url)
     site = site or u.site
     docid = ghash.doc_id(u.full)
-    tdoc: TokenizedDoc = (tokenize_html(content, u.full) if is_html
-                          else tokenize_text(content))
+    if tdoc is None:
+        tdoc = (tokenize_html(content, u.full) if is_html
+                else tokenize_text(content))
     edges = resolve_links(tdoc.links, u.full)
     if linkee_sites is None:
         resolver = site_resolver or (lambda lu: lu.site)
         linkee_sites = {lk.full: resolver(lk) for lk, _ in edges}
+    sect_of = doc_section_hashes(tdoc)
+    boiler = set(boiler_sections or [])
 
     doc_words = [t.word for t in tdoc.tokens]
     words = list(doc_words)
@@ -193,10 +218,22 @@ def build_meta_list(
     if len(words):
         termids = np.array([ghash.term_id(w) for w in words], dtype=np.uint64)
         density = _density_ranks(hashgroups, sentences)
+        doc_spam = _spam_ranks(doc_words)
+        if boiler:
+            # boilerplate-section demotion (the Sections dup-vote →
+            # score-weight flow): tokens of a section repeated across
+            # the site get their spam rank docked
+            from ..index.sectiondb import BOILER_SPAMRANK
+            bmask = np.array(
+                [sect_of.get(t.section_id) in boiler
+                 for t in tdoc.tokens], dtype=bool)
+            doc_spam = np.where(bmask,
+                                np.minimum(doc_spam, BOILER_SPAMRANK),
+                                doc_spam)
         spam = np.concatenate([
-            _spam_ranks(doc_words),
+            doc_spam,
             np.array(il_spam, dtype=np.uint64)]) if il_spam \
-            else _spam_ranks(doc_words)
+            else doc_spam
         keys = [posdb.pack(
             termid=termids, docid=docid, wordpos=wordpos,
             densityrank=density, wordspamrank=spam, siterank=siterank,
@@ -253,7 +290,9 @@ def build_meta_list(
             extra={"content": content, "is_html": is_html,
                    "meta_description": tdoc.meta_description,
                    "inlinks": [[t, sr] for t, sr in inlinks],
-                   "linkee_sites": linkee_sites},
+                   "linkee_sites": linkee_sites,
+                   "sections": sorted(set(sect_of.values())),
+                   "boiler_sections": sorted(boiler)},
         )
     sitehash = ghash.hash64(site) & ((1 << clusterdb.SITEHASH_BITS) - 1)
     return MetaList(
@@ -268,6 +307,8 @@ def build_meta_list(
         words=doc_words,
         edges=edges,
         edge_sites=linkee_sites,
+        sections=sorted(set(sect_of.values())),
+        boiler_sections=sorted(boiler),
     )
 
 
@@ -383,12 +424,20 @@ def index_document(coll: Collection, url: str, content: str, *,
         siterank = sr_override
     old = remove_document(coll, url, _count=False, propagate=False)
     inlinks = coll.linkdb.inlinks_for_url(site, u.full)
+    # boilerplate gate (Sections dup votes): sections this page shares
+    # with enough sibling pages of the site demote at build time
+    tdoc = (tokenize_html(content, u.full) if is_html
+            else tokenize_text(content))
+    boiler = coll.sectiondb.boiler_set(
+        site, doc_section_hashes(tdoc).values())
     ml = build_meta_list(url, content, is_html=is_html, siterank=siterank,
                          langid=langid, inlinks=inlinks, site=site,
-                         site_resolver=coll.tagdb.site_of)
+                         site_resolver=coll.tagdb.site_of, tdoc=tdoc,
+                         boiler_sections=boiler)
     coll.posdb.add(ml.posdb_keys)
     coll.titledb.add(ml.titledb_key.reshape(1), [ml.title_rec])
     coll.clusterdb.add(ml.clusterdb_key.reshape(1))
+    coll.sectiondb.add_page_sections(site, u.full, ml.sections)
     coll.titlerec_cache.pop(ml.docid, None)
     if ml.words:
         coll.speller.add_doc_words(ml.words)
@@ -451,7 +500,8 @@ def tombstone_meta_list(rec: dict) -> MetaList:
                            inlinks=[tuple(x) for x in
                                     rec.get("inlinks") or []],
                            site=rec.get("site"),
-                           linkee_sites=rec.get("linkee_sites"))
+                           linkee_sites=rec.get("linkee_sites"),
+                           boiler_sections=rec.get("boiler_sections"))
 
 
 def remove_document(coll: Collection, url: str, _count: bool = True,
@@ -477,6 +527,8 @@ def remove_document(coll: Collection, url: str, _count: bool = True,
     coll.posdb.add(ml.posdb_keys)
     coll.titledb.add(ml.titledb_key.reshape(1), [b""])
     coll.clusterdb.add(ml.clusterdb_key.reshape(1))
+    coll.sectiondb.remove_page_sections(
+        ml.site, u.full, rec.get("sections") or [])
     coll.titlerec_cache.pop(ml.docid, None)
     # tombstone this page's outlink edges so its anchors stop feeding
     # linkee rankings (the old meta list's linkdb records, negated)

@@ -48,6 +48,21 @@ class Token:
     wordpos: int
     hashgroup: int
     sentence_id: int
+    #: tag-path section hash (Sections.cpp tree, flattened to the
+    #: SECOND-level container: wrapper <div>s collapse to their
+    #: children, so header/nav/main/footer blocks — the boilerplate
+    #: granularity — each get a stable cross-page id). 0 = no section.
+    section_id: int = 0
+
+
+#: tags that open a section scope (containers with matching end tags;
+#: void tags like <br>/<meta> never push)
+_SECTION_TAGS = {
+    "div", "section", "article", "header", "footer", "aside", "nav",
+    "menu", "table", "ul", "ol", "dl", "form", "blockquote", "p",
+    "li", "tr", "td", "th", "dd", "dt", "pre",
+    "h1", "h2", "h3", "h4", "h5", "h6",
+}
 
 
 @dataclass
@@ -75,6 +90,36 @@ class _HtmlTok(HTMLParser):
         self._anchor_href: str | None = None
         self._anchor_words: list[str] = []
         self._text_parts: list[str] = []
+        #: section stack: (tag, pathhash, child-ordinal counters)
+        self._sect_stack: list[tuple[str, int, dict]] = []
+        self._root_ordinals: dict = {}
+
+    def _sect_push(self, tag: str) -> None:
+        from ..utils import ghash
+        if self._sect_stack:
+            parent_hash = self._sect_stack[-1][1]
+            counters = self._sect_stack[-1][2]
+        else:
+            parent_hash = 0
+            counters = self._root_ordinals
+        ordinal = counters.get(tag, 0)
+        counters[tag] = ordinal + 1
+        ph = ghash.hash64(f"{parent_hash}:{tag}:{ordinal}")
+        self._sect_stack.append((tag, ph, {}))
+
+    def _sect_pop(self, tag: str) -> None:
+        # pop to the nearest matching open tag (HTML is messy; an
+        # unmatched end tag pops nothing)
+        for i in range(len(self._sect_stack) - 1, -1, -1):
+            if self._sect_stack[i][0] == tag:
+                del self._sect_stack[i:]
+                return
+
+    @property
+    def _section_id(self) -> int:
+        if not self._sect_stack:
+            return 0
+        return self._sect_stack[min(1, len(self._sect_stack) - 1)][1]
 
     # -- tag events --
 
@@ -108,6 +153,8 @@ class _HtmlTok(HTMLParser):
                 self._sent += 1
                 self._emit_words(content, HASHGROUP_INMETATAG)
                 self._sent += 1
+        if tag in _SECTION_TAGS:
+            self._sect_push(tag)
         if tag in _BLOCK_TAGS:
             self._pos += BLOCK_GAP
             self._sent += 1
@@ -118,6 +165,8 @@ class _HtmlTok(HTMLParser):
             return
         if self._skip_depth:
             return
+        if tag in _SECTION_TAGS:
+            self._sect_pop(tag)
         if tag == "title":
             self._title_depth = max(0, self._title_depth - 1)
         elif tag in _HEADING_TAGS:
@@ -162,6 +211,7 @@ class _HtmlTok(HTMLParser):
     # -- word emission with Pos.cpp-style position advance --
 
     def _emit_words(self, data: str, hashgroup: int) -> None:
+        sid = self._section_id
         for chunk in _SENT_SPLIT_RE.split(data):
             for m in _WORD_RE.finditer(chunk):
                 self.doc.tokens.append(Token(
@@ -169,6 +219,7 @@ class _HtmlTok(HTMLParser):
                     min(self._pos, MAXWORDPOS),
                     hashgroup,
                     self._sent,
+                    sid,
                 ))
                 self._pos += 1
             self._pos += SENT_GAP

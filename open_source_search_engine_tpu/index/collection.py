@@ -78,6 +78,8 @@ class Collection:
         self.linkdb = Linkdb(self.dir)
         from .tagdb import Tagdb
         self.tagdb = Tagdb(self.dir)
+        from .sectiondb import Sectiondb
+        self.sectiondb = Sectiondb(self.dir)
         from ..query.speller import Speller
         self.speller = Speller(self.dir)
         self._stats_path = self.dir / "collstats.json"
@@ -99,7 +101,8 @@ class Collection:
         set, ``Collectiondb.h:39``) — repair/resync/scrub iterate this."""
         return {"posdb": self.posdb, "titledb": self.titledb,
                 "clusterdb": self.clusterdb, "linkdb": self.linkdb.rdb,
-                "tagdb": self.tagdb.rdb}
+                "tagdb": self.tagdb.rdb,
+                "sectiondb": self.sectiondb.rdb}
 
     # --- stats used by ranking ---
 
@@ -119,15 +122,13 @@ class Collection:
     # --- lifecycle (Process::saveRdbTrees equivalent) ---
 
     def save(self) -> None:
-        for db in (self.posdb, self.titledb, self.clusterdb,
-                   self.linkdb.rdb, self.tagdb.rdb):
+        for db in self.rdbs().values():
             db.save()
         self.speller.save()
         self._save_stats()
 
     def dump_all(self) -> None:
-        for db in (self.posdb, self.titledb, self.clusterdb,
-                   self.linkdb.rdb, self.tagdb.rdb):
+        for db in self.rdbs().values():
             db.dump()
         self._save_stats()
 
@@ -138,13 +139,17 @@ class CollectionDb:
     def __init__(self, base_dir: str | Path):
         self.base_dir = Path(base_dir)
         self.colls: dict[str, Collection] = {}
+        import threading
+        self._lock = threading.Lock()  # lazy-open is check-then-create
 
     def get(self, name: str = "main", create: bool = True) -> Collection:
-        if name not in self.colls:
-            if not create and not (self.base_dir / "coll" / name).exists():
-                raise KeyError(f"no such collection: {name}")
-            self.colls[name] = Collection(name, self.base_dir)
-        return self.colls[name]
+        with self._lock:
+            if name not in self.colls:
+                if not create and not (self.base_dir / "coll"
+                                       / name).exists():
+                    raise KeyError(f"no such collection: {name}")
+                self.colls[name] = Collection(name, self.base_dir)
+            return self.colls[name]
 
     def names(self) -> list[str]:
         disk = {p.name for p in (self.base_dir / "coll").glob("*") if p.is_dir()}

@@ -1,0 +1,100 @@
+"""Sectiondb — per-site repeated-section votes for boilerplate demotion.
+
+Reference: ``Sections.cpp/h`` (``Sections.h:330``, ~18k LoC) builds a
+tag-path section tree per page and stores per-section content hashes in
+**sectiondb**, keyed by site; sections whose hash repeats across many of
+a site's pages are navigation/footer boilerplate, and their words get
+demoted at scoring time (the section "dup votes" flow through the
+scoring weights).
+
+Lite redesign, same behavior where it matters for ranking: the
+tokenizer tags every token with a tag-path section id; the indexer
+hashes each section's word content and looks the hash up here — a
+section already seen on ``BOILER_MIN_PAGES`` other pages of the same
+site is boilerplate, and its tokens' wordspamrank is docked to
+``BOILER_SPAMRANK`` (weight (r+1)/16 — the reference likewise routes
+the demotion through the spam/quality slot). Records are one per
+(site, section hash, page), so the vote count is a single range read.
+
+Keys: n1 = sitehash32<<32 | secthash32 (sort: one site's one section
+is a contiguous range), n0 = urlhash63<<1 | delbit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils import ghash
+from . import rdblite
+
+KEY_DTYPE = np.dtype([("n0", "<u8"), ("n1", "<u8")], align=False)
+
+#: a section seen on this many OTHER pages of the site is boilerplate
+BOILER_MIN_PAGES = 2
+
+#: wordspamrank for boilerplate-section tokens (weight 6/16 = 0.375)
+BOILER_SPAMRANK = 5
+
+#: ignore tiny sections (a 1-2 word <div> is noise, not boilerplate)
+MIN_SECTION_WORDS = 3
+
+
+def _h32(s: str) -> int:
+    return ghash.hash64(s) & 0xFFFFFFFF
+
+
+def pack_key(site: str, secthash: int, url: str,
+             delbit: int = 1) -> np.ndarray:
+    k = np.zeros((), dtype=KEY_DTYPE)
+    k["n1"] = np.uint64((_h32(site) << 32) | (secthash & 0xFFFFFFFF))
+    k["n0"] = np.uint64(((ghash.hash64(url) & 0x7FFFFFFFFFFFFFFF) << 1)
+                        | (delbit & 1))
+    return k
+
+
+def _range(site: str, secthash: int):
+    n1 = np.uint64((_h32(site) << 32) | (secthash & 0xFFFFFFFF))
+    lo = np.zeros((), dtype=KEY_DTYPE)
+    lo["n1"] = n1
+    hi = np.zeros((), dtype=KEY_DTYPE)
+    hi["n1"] = n1
+    hi["n0"] = np.uint64(0xFFFFFFFFFFFFFFFF)
+    return lo, hi
+
+
+class Sectiondb:
+    """Per-node section-vote database (an Rdb like the others)."""
+
+    def __init__(self, directory):
+        self.rdb = rdblite.Rdb("sectiondb", directory, KEY_DTYPE)
+
+    def add_page_sections(self, site: str, url: str,
+                          secthashes) -> None:
+        if not secthashes:
+            return
+        keys = np.concatenate([pack_key(site, h, url).reshape(1)
+                               for h in secthashes])
+        self.rdb.add(keys)
+
+    def remove_page_sections(self, site: str, url: str,
+                             secthashes) -> None:
+        if not secthashes:
+            return
+        keys = np.concatenate([pack_key(site, h, url, delbit=0).reshape(1)
+                               for h in secthashes])
+        self.rdb.add(keys)
+
+    def page_count(self, site: str, secthash: int) -> int:
+        """How many of the site's pages contain this exact section."""
+        return int(len(self.rdb.get_list(*_range(site, secthash))))
+
+    def boiler_set(self, site: str, secthashes) -> set[int]:
+        """The subset of a page's sections that are site boilerplate
+        (already on ≥ BOILER_MIN_PAGES other pages)."""
+        if self.rdb.mem.nbytes == 0 and not self.rdb.runs:
+            return set()
+        return {h for h in secthashes
+                if self.page_count(site, h) >= BOILER_MIN_PAGES}
+
+    def save(self) -> None:
+        self.rdb.save()

@@ -121,6 +121,10 @@ class ShardNodeServer:
     def __init__(self, data_dir: str | Path, host: str = "127.0.0.1",
                  port: int = 0, use_device: bool = False):
         self.coll = Collection("shard", data_dir)
+        # per-shard results feed the CLIENT-side merge, which applies
+        # PostQueryRerank once over the merged page — node-side PQR
+        # would demote twice and skew the cross-shard merge
+        self.coll.conf.pqr_enabled = False
         self.host = host
         self.port = port
         self.use_device = use_device
@@ -173,6 +177,12 @@ class ShardNodeServer:
         if path == "/rpc/ping":
             # lock-free: a long write/checkpoint must not fail heartbeats
             return {"ok": True, "docs": self.coll.num_docs}
+        if path == "/rpc/heal":
+            # outside the writer lock: heal_from pulls for minutes and
+            # takes the lock only for its atomic apply step — holding
+            # it here would block every index/search on this node
+            n = self.heal_from(payload["from"])
+            return {"ok": True, "healed_rdbs": n}
         with self._lock:
             if path == "/rpc/index":
                 self._journal_write({"url": payload["url"],
@@ -223,9 +233,6 @@ class ShardNodeServer:
                     return {"ok": False, "error": f"no rdb {name}"}
                 return {"ok": True, "batch": _encode_batch(rdb.get_all()),
                         "num_docs": self.coll.num_docs}
-            if path == "/rpc/heal":
-                n = self.heal_from(payload["from"])
-                return {"ok": True, "healed_rdbs": n}
         raise KeyError(path)
 
     def scrub(self) -> list[str]:
@@ -417,6 +424,10 @@ class ClusterClient:
         self._queues = {(s, r): _HostQueue()
                         for s in range(conf.n_shards)
                         for r in range(conf.n_replicas)}
+        #: per-twin read-latency EWMA — the request-load-balancing
+        #: signal (least-loaded twin serves reads)
+        self._read_ewma = [[0.0] * conf.n_replicas
+                           for _ in range(conf.n_shards)]
         self._stop = threading.Event()
         self._pool = ThreadPoolExecutor(
             max_workers=max(4, 2 * conf.n_shards * conf.n_replicas))
@@ -555,15 +566,25 @@ class ClusterClient:
 
     def _read_shard(self, shard: int, path: str, payload: dict
                     ) -> dict | None:
-        """Try twins in liveness order; mark failures dead and reroute
-        (Multicast.cpp:520). None = whole shard down."""
-        order = sorted(range(self.conf.n_replicas),
-                       key=lambda r: not self.hostmap.alive[shard, r])
+        """Try twins in (liveness, least-observed-latency) order; mark
+        failures dead and reroute (Multicast.cpp:520 — the reference
+        likewise prefers the less-loaded twin via its ping/load info).
+        None = whole shard down. The EWMA of per-read latency is the
+        load signal: a twin bogged down by a merge or a heal answers
+        slower and organically sheds read traffic to its sibling."""
+        order = sorted(
+            range(self.conf.n_replicas),
+            key=lambda r: (not self.hostmap.alive[shard, r],
+                           self._read_ewma[shard][r]))
         for r in order:
+            t0 = time.monotonic()
             try:
                 out = _rpc(self.conf.addresses[shard][r], path, payload)
                 if out.get("ok") or "total" in out:
                     self.hostmap.mark_alive(shard, r)
+                    dt = time.monotonic() - t0
+                    self._read_ewma[shard][r] = (
+                        0.8 * self._read_ewma[shard][r] + 0.2 * dt)
                     return out
             except Exception:  # noqa: BLE001
                 self.hostmap.mark_dead(shard, r)
@@ -577,13 +598,16 @@ class ClusterClient:
     # --- scatter-gather query (Msg3a) ------------------------------------
 
     def search(self, q: str, topk: int = 10, lang: int = 0,
-               with_snippets: bool = True, site_cluster: bool = True):
+               with_snippets: bool = True, site_cluster: bool = True,
+               offset: int = 0, conf=None):
         """Fan out to every shard's serving twin, merge top-k, then
         fetch titlerecs from the owning shards (Msg20)."""
         from ..query.compiler import compile_query
-        from ..query.engine import SearchResults, build_results
+        from ..query.engine import (PQR_SCAN, SearchResults,
+                                    build_results, finish_page)
 
-        over = max(topk * 2, 16)
+        want = max(topk + offset, PQR_SCAN)
+        over = max(want * 2, 16)
         futs = [self._pool.submit(
             self._read_shard, s, "/rpc/search",
             {"q": q, "topk": over, "lang": lang})
@@ -606,15 +630,22 @@ class ClusterClient:
         # prefetch the likely titlerecs concurrently (the reference
         # launches its Msg20 summary requests in parallel,
         # Msg40::launchMsg20s); build_results then reads the cache
-        want = [docids[i] for i in order[: topk + 8]]
-        fetched = dict(zip(want, self._pool.map(self.get_document, want)))
+        prefetch = [docids[i] for i in order[: want + 8]]
+        fetched = dict(zip(prefetch,
+                           self._pool.map(self.get_document, prefetch)))
         get_doc = lambda d: fetched.get(d) if d in fetched \
             else self.get_document(d)
         results, clustered = build_results(
             get_doc,
             [docids[i] for i in order], [scores[i] for i in order],
-            plan, topk=topk, with_snippets=with_snippets,
+            plan, topk=want, with_snippets=False,
             site_cluster=site_cluster)
+        page = finish_page(
+            results, offset=offset, topk=topk, conf=conf, qlang=lang,
+            get_doc=get_doc,
+            langid_of=lambda d: (fetched.get(d) or {}).get("langid", 0),
+            words=[g.display for g in plan.scored_groups],
+            with_snippets=with_snippets)
         return SearchResults(
-            query=q, total_matches=total, results=results,
+            query=q, total_matches=total, results=page,
             clustered=clustered, degraded=degraded)

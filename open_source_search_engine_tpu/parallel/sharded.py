@@ -176,10 +176,17 @@ class ShardedCollection:
         self.mutations += 1
         old = self.remove_document(url, propagate=False)
         inlinks = self._linkdb_of(site).inlinks_for_url(site, u.full)
+        from ..build.tokenizer import tokenize_html, tokenize_text
+        tdoc = (tokenize_html(content, u.full) if is_html
+                else tokenize_text(content))
+        sect_shard = int(self.hostmap.shard_of_site(site))
+        boiler = self.shards[sect_shard].sectiondb.boiler_set(
+            site, docproc.doc_section_hashes(tdoc).values())
         ml = docproc.build_meta_list(url, content, is_html=is_html,
                                      siterank=siterank, langid=langid,
                                      inlinks=inlinks, site=site,
-                                     site_resolver=self.tagdb.site_of)
+                                     site_resolver=self.tagdb.site_of,
+                                     tdoc=tdoc, boiler_sections=boiler)
         home = int(self.hostmap.shard_of_docid(ml.docid))
         key_shards = self.hostmap.shard_of_keys(ml.posdb_keys)
         # every record goes to ALL twins of its owning shard (the Msg1
@@ -194,6 +201,8 @@ class ShardedCollection:
             coll.doc_added()
             if ml.words:
                 coll.speller.add_doc_words(ml.words)
+        for coll in self.replicas_of(sect_shard):
+            coll.sectiondb.add_page_sections(site, u.full, ml.sections)
         # outlink edges → linkee-site shards; refresh affected linkees
         # (shared propagate step, including the old version's linkees)
         edges = ml.edges
@@ -250,6 +259,10 @@ class ShardedCollection:
                 coll.speller.remove_doc_words(dead.words)
             coll.doc_removed()
         u = normalize(url)
+        for coll in self.replicas_of(
+                int(self.hostmap.shard_of_site(dead.site))):
+            coll.sectiondb.remove_page_sections(
+                dead.site, u.full, ml.get("sections") or [])
         edges = dead.edges
         for linkee, _anchor in edges:
             # delete under the boundary frozen at add time (titlerec map)
@@ -278,6 +291,11 @@ class ShardedCollection:
         """Integrity sweep over every replica's every Rdb; corrupt runs
         are quarantined and immediately healed from a live twin."""
         report: dict[str, list[str]] = {}
+        to_heal: list[tuple[int, int]] = []
+        # pass 1: scrub EVERY replica before any resync — healing from
+        # a not-yet-scrubbed sibling could install ITS undetected
+        # corruption over recoverable state (each twin may hold the
+        # good copy of a different Rdb)
         for s in range(self.n_shards):
             for r, coll in enumerate(self.grid[s]):
                 for name, rdb in coll.rdbs().items():
@@ -289,7 +307,10 @@ class ShardedCollection:
                        for run in rdb.quarantined]
                 if bad:
                     report[f"shard{s}_r{r}"] = bad
-                    self.resync_replica(s, r)
+                    to_heal.append((s, r))
+        # pass 2: heal
+        for s, r in to_heal:
+            self.resync_replica(s, r)
         return report
 
     def resync_replica(self, shard: int, replica: int) -> bool:
@@ -442,6 +463,7 @@ def _global_freq_weights(preps: list[PreparedQuery | None],
 
 def sharded_search(sc: ShardedCollection, q: str | QueryPlan, *,
                    mesh=None, topk: int = 10, lang: int = 0,
+                   offset: int = 0,
                    with_snippets: bool = True,
                    site_cluster: bool = True) -> SearchResults:
     """Scatter-gather query over the mesh (Msg40→Msg3a→Msg39 path)."""
@@ -472,7 +494,7 @@ def sharded_search(sc: ShardedCollection, q: str | QueryPlan, *,
     D = max(len(p.siterank) for p in live)
     packs = [_pad_packed(p, T, L, D, plan, freqw) for p in packs]
 
-    k = min(max(topk, 64), D)
+    k = min(max(topk + offset, 64), D)
     stack = lambda f: np.stack([f(p) for p in packs])
     args = dict(
         doc_idx=stack(lambda p: p.doc_idx),
@@ -501,7 +523,9 @@ def sharded_search(sc: ShardedCollection, q: str | QueryPlan, *,
     # re-merge with a larger out_k (the reference's Msg40 recall loop,
     # Msg40.cpp:2117, redesigned as k·c over-fetch per SURVEY §7 hard
     # part (c) — the per-shard scoring is cached, only the merge regrows)
-    out_k = max(topk, 64)
+    from ..query.engine import PQR_SCAN, finish_page
+    want = max(topk + offset, PQR_SCAN)
+    out_k = max(want, 64)
     max_out = sc.n_shards * k
     while True:
         kk = min(out_k, max_out)
@@ -529,13 +553,22 @@ def sharded_search(sc: ShardedCollection, q: str | QueryPlan, *,
             else:
                 m_sc[i] = 0.0
         results, clustered = build_results(
-            sc.get_document, docids, m_sc, plan, topk=topk,
-            with_snippets=with_snippets, site_cluster=site_cluster)
-        if (len(results) >= topk or clustered == 0 or out_k >= max_out):
+            sc.get_document, docids, m_sc, plan, topk=want,
+            with_snippets=False, site_cluster=site_cluster)
+        if (len(results) >= want or clustered == 0 or out_k >= max_out):
             break
         out_k *= 4
+    from ..query.engine import _coll_langid_of
+    page = finish_page(
+        results, offset=offset, topk=topk,
+        conf=sc.shards[0].conf, qlang=plan.lang,
+        get_doc=sc.get_document,
+        langid_of=lambda d: _coll_langid_of(
+            sc.shards[int(sc.hostmap.shard_of_docid(d))])(d),
+        words=[g.display for g in plan.scored_groups],
+        with_snippets=with_snippets)
     return SearchResults(
-        query=plan.raw, total_matches=int(total), results=results,
+        query=plan.raw, total_matches=int(total), results=page,
         clustered=clustered, degraded=degraded,
         suggestion=suggest_sharded(sc, plan) if total == 0 else None)
 

@@ -118,11 +118,21 @@ DOC_UPD_FLOOR = 64
 #: doc-capacity quantum (D_cap bucket unit)
 DOC_QUANTUM = 2048
 
-#: HBM budget for dense [V, D_cap] impact+runstart rows (8 bytes/doc/term)
-DENSE_BUDGET_BYTES = 256 << 20
+#: HBM budget for dense [V, D_cap] impact+runstart rows (8 bytes/doc/
+#: term). Sized so that at web-shard scale (~500k docs) the heaviest
+#: ~400 terms are dense, and at 100k docs EVERY df>tau term is — a
+#: sparse run that should have been dense pays scalar-scatter for its
+#: whole doc run on every query, measured as THE dominant query cost
+DENSE_BUDGET_BYTES = 1536 << 20
 
 #: minimum df for a term to earn a dense impact row
 DENSE_MIN_DF = 1024
+
+#: sparse doc-runs are CHUNKED to this many lanes per row, so the lane
+#: bucket is a compile-time constant (no per-query Lsp recompiles) and
+#: pad lanes never exceed one chunk per term — unbudgeted big terms
+#: degrade linearly instead of rectangularly
+LSP_MAX = 2048
 
 #: HBM budget for materialized [P, D_cap] cube rows (P·4 bytes/doc/term)
 CUBE_BUDGET_BYTES = 768 << 20
@@ -167,12 +177,13 @@ def _posscore_np(f: dict[str, np.ndarray]) -> np.ndarray:
 
 def _impacts_np(f: dict[str, np.ndarray], termids: np.ndarray,
                 docidx: np.ndarray, runstart: np.ndarray) -> np.ndarray:
-    """Admissible per-(term, doc) single-score bound, tight for the
-    common case: Σ over mapped hashgroups of the max position score,
-    plus every inlink-text occurrence individually — exactly the
-    candidate set getSingleTermScore tops-and-sums (Posdb.cpp:3087),
-    summed without the top-MAX_TOP cut (≥ the exact score, equal when a
-    doc has ≤ MAX_TOP contributing groups)."""
+    """Admissible per-(term, doc) single-score bound, TIGHT: Σ over the
+    top-MAX_TOP of {per-mapped-hashgroup position maxima} ∪ {every
+    inlink-text occurrence} — exactly the candidate set
+    getSingleTermScore tops-and-sums (Posdb.cpp:3087). With the cut
+    applied the bound equals the exact single-term score up to float
+    association, so single-group queries prune at the smallest κ rung
+    (the candidate pass ranks them essentially exactly)."""
     n = len(termids)
     if n == 0:
         return np.empty(0, np.float32)
@@ -192,10 +203,23 @@ def _impacts_np(f: dict[str, np.ndarray], termids: np.ndarray,
     gmax = np.maximum.reduceat(ps_o, gstart)
     gsum = np.add.reduceat(ps_o, gstart)
     gval = np.where(il_o[gstart], gsum, gmax)
+    # inlink groups contribute each occurrence separately to the
+    # top-MAX_TOP candidate pool; approximate their pool entry by the
+    # whole-group sum (≥ exact, still admissible; non-inlink docs —
+    # the overwhelming majority — get the exact cut)
     pch = np.ones(len(gstart), bool)
     pch[1:] = ((t_o[gstart][1:] != t_o[gstart][:-1])
                | (d_o[gstart][1:] != d_o[gstart][:-1]))
-    imp = np.add.reduceat(gval, np.nonzero(pch)[0])
+    pstart = np.nonzero(pch)[0]
+    pair_id = np.cumsum(pch) - 1               # group → owning pair
+    # rank each group's value within its pair (descending) and zero
+    # everything past MAX_TOP before the pair sum
+    order2 = np.lexsort((-gval, pair_id))
+    ranked = np.empty(len(gval), np.int64)
+    pos_in_pair = np.arange(len(gval)) - pstart[pair_id[order2]]
+    ranked[order2] = pos_in_pair
+    gval_cut = np.where(ranked < weights.MAX_TOP, gval, 0.0)
+    imp = np.add.reduceat(gval_cut, pstart)
     assert len(imp) == len(runstart)
     # tiny floor keeps zero-weight hashgroups present-but-worthless
     return np.maximum(imp, 1e-30).astype(np.float32)
@@ -240,37 +264,68 @@ def _write_tail(buf, tail, offset):
     return jax.lax.dynamic_update_slice(buf, tail, (offset,))
 
 
-def _block_top2(x, n_sel: int):
-    """Top-2-per-block candidate selection: (vals [n_sel], idx [n_sel],
-    missed_max) — n_sel/2 blocks of size D/(n_sel/2), the two best docs
-    of each block selected, ``missed_max`` = the best value NOT selected
-    (3rd-best over any block).
+def _block_topn(x, n_sel: int, per_block: int = 8):
+    """Top-``per_block``-per-block candidate selection: (vals [n_sel],
+    idx [n_sel], missed_max) — n_sel/per_block blocks, the best
+    per_block docs of each selected, ``missed_max`` = the best value
+    NOT selected ((per_block+1)-th best over any block).
 
-    This replaces ``lax.top_k``/``approx_max_k`` for candidate selection:
-    both lower to sort-like programs that cost 300 ms-2.4 s per batch on
-    a [B, 131072] score axis (measured), while this is six reshaped
-    max-reduces (~2 ms). Selection can miss a doc only when ≥3 candidates
-    share one block; the caller compares ``missed_max`` against its
-    result floor and escalates with more blocks — the same lossless
-    pruning contract as everywhere else."""
+    This replaces ``lax.top_k``/``approx_max_k`` for candidate
+    selection: both lower to sort-like programs that cost 300 ms-2.4 s
+    per batch on a [B, 131072] score axis (measured), while this is a
+    handful of reshaped max-reduces (~2 ms). per_block sets the
+    collision robustness: selecting k winners across nb blocks misses
+    only when one block holds > per_block of them — at per_block=8 and
+    k ≈ n_sel/4 that's a ≲1% event (Poisson tail), vs near-certain at
+    per_block=2 with few blocks. The caller compares ``missed_max``
+    against its result floor and escalates with more blocks — the same
+    lossless pruning contract as everywhere else."""
     D = x.shape[0]
-    nb = max(n_sel // 2, 1)
+    nb = max(n_sel // per_block, 1)
     while D % nb:  # D is a power-of-two bucket, but stay safe
         nb //= 2
     R = D // nb
     xb = x.reshape(nb, R)
     iota = jnp.arange(R, dtype=jnp.int32)[None, :]
-    m1 = jnp.max(xb, axis=1)
-    a1 = jnp.argmax(xb, axis=1).astype(jnp.int32)
-    x2 = jnp.where(iota == a1[:, None], -jnp.inf, xb)
-    m2 = jnp.max(x2, axis=1)
-    a2 = jnp.argmax(x2, axis=1).astype(jnp.int32)
-    x3 = jnp.where(iota == a2[:, None], -jnp.inf, x2)
-    missed = jnp.maximum(jnp.max(x3), 0.0)
     base = jnp.arange(nb, dtype=jnp.int32) * R
-    vals = jnp.concatenate([m1, jnp.maximum(m2, 0.0)])
-    idx = jnp.concatenate([base + a1, base + a2])
-    return vals, idx, missed
+    vals_l, idx_l = [], []
+    cur = xb
+    for t in range(per_block):
+        m = jnp.max(cur, axis=1)
+        a = jnp.argmax(cur, axis=1).astype(jnp.int32)
+        vals_l.append(m if t == 0 else jnp.maximum(m, 0.0))
+        idx_l.append(base + a)
+        cur = jnp.where(iota == a[:, None], -jnp.inf, cur)
+    missed = jnp.maximum(jnp.max(cur), 0.0)
+    return (jnp.concatenate(vals_l), jnp.concatenate(idx_l), missed)
+
+
+def _block_top2(x, n_sel: int):
+    return _block_topn(x, n_sel, per_block=2)
+
+
+@partial(jax.jit, static_argnames=("V", "D", "n_lanes"))
+def _build_dense_rows(d_doc, d_imp, d_rsp, starts, cum,
+                      V: int, D: int, n_lanes: int):
+    """Dense [V, D] impact + runstart rows, built by one flattened
+    scatter over the doc-pair columns. Lane → row via searchsorted on
+    the cumulative-length table; everything stays on device — the host
+    ships only (starts, cum), a few KB."""
+    R = starts.shape[0]
+    lane = jnp.arange(n_lanes, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(cum, lane, side="right") - 1,
+                   0, R - 1).astype(jnp.int32)
+    src = jnp.clip(starts[row] + lane - cum[row], 0,
+                   d_doc.shape[0] - 1)
+    valid = lane < cum[-1]
+    doc = d_doc[src].astype(jnp.int32)
+    # dst fits int32: V·D ≤ DENSE_BUDGET/8 < 2^31
+    dst = jnp.where(valid, row * D + doc, V * D)
+    imp = jnp.zeros((V * D,), jnp.float32).at[dst].set(
+        d_imp[src], mode="drop")
+    rsp = jnp.zeros((V * D,), jnp.int32).at[dst].set(
+        d_rsp[src], mode="drop")
+    return imp.reshape(V, D), rsp
 
 
 @partial(jax.jit, static_argnames=("total",))
@@ -329,6 +384,7 @@ class ResidentPlan:
     qlang: int
     matchable: bool
     driver_df: int = 0       # min required-group df (routes F1 vs F2)
+    kappa_min: int = 0       # escalation floor (set on a pruning miss)
 
 
 class DeviceIndex:
@@ -354,7 +410,10 @@ class DeviceIndex:
         if rdb.version == self._built_version:
             return False
         self._sitehash = None  # clusterdb view refreshes lazily
-        fp = tuple((r.path.name, len(r)) for r in rdb.runs)
+        # content-addressed fingerprint: keys_crc makes a rebuilt run
+        # with a coincidentally identical (name, count) miss the cache
+        fp = tuple((r.path.name, len(r), r.meta.get("keys_crc"))
+                   for r in rdb.runs)
         if fp != self._base_fp:
             self._build_base(fp)
         # the delta can outgrow the doc-capacity headroom AND the
@@ -374,15 +433,67 @@ class DeviceIndex:
         self._built_version = rdb.version
         return True
 
+    #: bump when any derived-column computation changes (cache schema)
+    _CACHE_SCHEMA = 2  # v2: top-MAX_TOP-cut impacts
+
+    def _cache_path(self, fp):
+        import hashlib
+        h = hashlib.sha1(repr((fp, self.P, self._CACHE_SCHEMA))
+                         .encode()).hexdigest()[:16]
+        return self.coll.posdb.dir / "devcache" / f"base_{h}.npz"
+
+    def _load_base_cache(self, fp):
+        """Derived base columns, cached on disk per run-set fingerprint
+        (the expensive host derivation — 25M-posting merge + impact
+        bounds — runs once per dump/merge, not once per process; a
+        restarted node rebuilds its device mirror at transfer speed)."""
+        p = self._cache_path(fp)
+        if not p.exists():
+            return None
+        try:
+            z = np.load(p)
+            return tuple(z[k] for k in (
+                "dir_termids", "base_df", "dir_dstart", "dir_pstart",
+                "base_docids", "docidx", "pocc", "payload", "doc_col",
+                "imp_col", "rsp_col", "siterank", "langid"))
+        except Exception:  # torn write etc. — recompute
+            return None
+
+    def _save_base_cache(self, fp, docidx, pocc, payload, doc_col,
+                         imp_col, rsp_col, siterank, langid) -> None:
+        p = self._cache_path(fp)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        for old in p.parent.glob("base_*.npz"):
+            old.unlink()  # only the live fingerprint is useful
+        tmp = p.with_suffix(".tmp.npz")
+        np.savez(tmp, dir_termids=self.dir_termids,
+                 base_df=self.base_df, dir_dstart=self.dir_dstart,
+                 dir_pstart=self.dir_pstart,
+                 base_docids=self.base_docids, docidx=docidx, pocc=pocc,
+                 payload=payload, doc_col=doc_col, imp_col=imp_col,
+                 rsp_col=rsp_col, siterank=siterank, langid=langid)
+        tmp.rename(p)
+
     def _build_base(self, fp, min_docs: int = 0, min_delta: int = 0
                     ) -> None:
         """Base columns from the Rdb's immutable runs (merged, tombstones
         annihilated — the Msg5 read collapsed to one columnar merge),
         plus preallocated delta tails."""
         runs = self.coll.posdb.runs
-        batch = merge_batches([r.batch() for r in runs]) if runs else None
         P = self.P
-        if batch is not None and len(batch):
+        cached = self._load_base_cache(fp)
+        if cached is not None:
+            (self.dir_termids, self.base_df, self.dir_dstart,
+             self.dir_pstart, self.base_docids, docidx, pocc, payload,
+             doc_col, imp_col, rsp_col, siterank, langid) = cached
+            n = len(docidx)
+            batch = None
+        else:
+            batch = merge_batches([r.batch() for r in runs]) \
+                if runs else None
+        if cached is not None:
+            pass
+        elif batch is not None and len(batch):
             f = posdb.unpack(batch.keys)
             termids, docids = f["termid"], f["docid"]
             occ = _occ_ranks(termids, docids)
@@ -422,6 +533,8 @@ class DeviceIndex:
             self.dir_pstart = np.r_[tstarts, n].astype(np.int64)
             siterank = f["siterank"].astype(np.int32)
             langid = f["langid"].astype(np.int32)
+            self._save_base_cache(fp, docidx, pocc, payload, doc_col,
+                                  imp_col, rsp_col, siterank, langid)
         else:
             self.dir_termids = np.empty(0, np.uint64)
             self.base_df = np.empty(0, np.int64)
@@ -451,7 +564,10 @@ class DeviceIndex:
             dl[docidx[first]] = langid[first]
 
         # --- dense rows: highest-df terms get a dense [D_cap] impact +
-        # runstart row (phase 1 adds them with zero gather/scatter) ---
+        # runstart row (phase 1 adds them with zero gather/scatter).
+        # Built DEVICE-side by one flattened scatter from the doc-pair
+        # columns (uploading [V, D] host arrays would ship ~GBs through
+        # the host link; the descriptors below are a few KB) ---
         dfs = np.diff(self.dir_dstart)
         tau = max(DENSE_MIN_DF, self.D_cap // 64)
         slots_budget = max(DENSE_BUDGET_BYTES // (8 * self.D_cap), 1)
@@ -459,13 +575,13 @@ class DeviceIndex:
         eligible = eligible[np.argsort(-dfs[eligible], kind="stable")]
         dense_terms = eligible[:slots_budget]
         V = _bucket(max(len(dense_terms), 1), 8)
-        dense_imp = np.zeros((V, self.D_cap), np.float32)
-        dense_rsp = np.zeros((V, self.D_cap), np.int32)
         self.dense_slot_of: dict[int, int] = {}
+        dr_starts = np.zeros(max(len(dense_terms), 1), np.int32)
+        dr_lens = np.zeros(max(len(dense_terms), 1), np.int64)
         for slot, ti in enumerate(dense_terms):
             a, b = int(self.dir_dstart[ti]), int(self.dir_dstart[ti + 1])
-            dense_imp[slot, doc_col[a:b]] = imp_col[a:b]
-            dense_rsp[slot, doc_col[a:b]] = rsp_col[a:b]
+            dr_starts[slot] = a
+            dr_lens[slot] = b - a
             self.dense_slot_of[int(self.dir_termids[ti])] = slot
 
         # --- cube rows: the very heaviest terms' [P, D] position cubes,
@@ -502,8 +618,12 @@ class DeviceIndex:
         self.d_doc = jax.device_put(_pad_col(doc_col, self.Mb + self.M2))
         self.d_imp = jax.device_put(_pad_col(imp_col, self.Mb + self.M2))
         self.d_rsp = jax.device_put(_pad_col(rsp_col, self.Mb + self.M2))
-        self.d_dense_imp = jax.device_put(dense_imp)
-        self.d_dense_rsp = jax.device_put(dense_rsp.reshape(-1))
+        dr_cum = np.r_[0, np.cumsum(dr_lens)].astype(np.int32)
+        self.d_dense_imp, self.d_dense_rsp = _build_dense_rows(
+            self.d_doc, self.d_imp, self.d_rsp,
+            jax.device_put(dr_starts), jax.device_put(dr_cum),
+            V=V, D=self.D_cap,
+            n_lanes=_bucket(max(int(dr_cum[-1]), 1), COL_QUANTUM))
         self.d_siterank = jax.device_put(sr)
         self.d_doclang = jax.device_put(dl)
         self.d_dead = jax.device_put(np.zeros(self.D_cap, bool))
@@ -691,24 +811,43 @@ class DeviceIndex:
     def n_docs(self) -> int:
         return len(self.all_docids)
 
-    def sitehash_of(self, docid: int) -> int:
-        """Query-time clusterdb read (Clusterdb.h:42 / Msg51.h:96):
-        the docid's 26-bit sitehash from the dataless clusterdb records
-        — site clustering runs off this column WITHOUT touching titledb
-        until the summary stage. Lazily built, aligned to all_docids."""
+    def _cluster_cols(self):
+        """Lazily materialized clusterdb columns aligned to all_docids
+        (Clusterdb.h:42 — sitehash + langid per docid, dataless)."""
         if getattr(self, "_sitehash", None) is None:
             cl = self.coll.clusterdb.get_all()
             sh = np.zeros(len(self.all_docids), np.int64)
+            lg = np.zeros(len(self.all_docids), np.int64)
             if len(cl):
                 f = clusterdb_mod.unpack_key(cl.keys)
                 pos = np.searchsorted(self.all_docids, f["docid"])
                 ok = pos < len(self.all_docids)
                 ok[ok] = self.all_docids[pos[ok]] == f["docid"][ok]
                 sh[pos[ok]] = f["sitehash"][ok].astype(np.int64)
+                lg[pos[ok]] = f["langid"][ok].astype(np.int64)
             self._sitehash = sh
+            self._langid_col = lg
+        return self._sitehash, self._langid_col
+
+    def sitehash_of(self, docid: int) -> int:
+        """Query-time clusterdb read (Clusterdb.h:42 / Msg51.h:96):
+        the docid's 26-bit sitehash from the dataless clusterdb records
+        — site clustering runs off this column WITHOUT touching titledb
+        until the summary stage. Lazily built, aligned to all_docids."""
+        sh, _ = self._cluster_cols()
         i = int(np.searchsorted(self.all_docids, np.uint64(docid)))
         if i < len(self.all_docids) and self.all_docids[i] == docid:
-            return int(self._sitehash[i])
+            return int(sh[i])
+        return 0
+
+    def langid_of(self, docid: int) -> int:
+        """Docid → langid from the same clusterdb columns (feeds the
+        PostQueryRerank foreign-language demotion without a titlerec
+        fetch)."""
+        _, lg = self._cluster_cols()
+        i = int(np.searchsorted(self.all_docids, np.uint64(docid)))
+        if i < len(self.all_docids) and self.all_docids[i] == docid:
+            return int(lg[i])
         return 0
 
     # --- planning --------------------------------------------------------
@@ -768,12 +907,18 @@ class DeviceIndex:
                 base, quota = sp[s_i]
                 for is_base, a, ln, dslot, cslot, pa, pl in \
                         self._druns_of(sub.termid):
-                    # F1 row split: dense [D] impact row vs sparse run
+                    # F1 row split: dense [D] impact row vs sparse run.
+                    # Sparse runs chunk at LSP_MAX so the lane bucket is
+                    # a constant (one compile) and an unbudgeted big
+                    # term costs lanes ∝ its real size, not Rs×max
                     if dslot >= 0:
                         drows.append((dslot, g_i, base, quota, syn))
                     else:
-                        srows.append((a, ln, g_i, base, quota, syn,
-                                      is_base))
+                        for off in range(0, ln, LSP_MAX):
+                            srows.append((a + off,
+                                          min(ln - off, LSP_MAX),
+                                          g_i, base, quota, syn,
+                                          is_base))
                     # F2 row split: materialized cube slice vs posting
                     # scatter; oversized runs split into several bounded
                     # scatter rows (postings carry their own doc+occ, so
@@ -876,9 +1021,12 @@ class DeviceIndex:
         if not live:
             return results
         # corpus-relative routing: a driver matching more than ~1/8th of
-        # the corpus (or CUBE_MIN_DF, whichever is smaller) prunes badly
-        # — full-cube scoring is cheaper than the escalation ladder
-        f2_cut = min(CUBE_MIN_DF, max(2 * KAPPA_FLOOR, self.n_docs // 8))
+        # the corpus (capped at the κ ladder's top rung) prunes badly —
+        # full-cube scoring is cheaper than the escalation ladder. With
+        # dense impact rows covering mid-df terms, F1 stays cheap up to
+        # κ=8192, so only genuinely corpus-wide drivers route to F2
+        f2_cut = min(4 * CUBE_MIN_DF,
+                     max(2 * KAPPA_FLOOR, self.n_docs // 8))
         f2 = [i for i in live if plans[i].driver_df > f2_cut]
         f1 = [i for i in live if i not in set(f2)]
 
@@ -887,8 +1035,17 @@ class DeviceIndex:
         # pruning check failed go into the (rare) next wave with 4x the
         # selection blocks — terminal at D_cap, where selection is
         # complete and the check passes by construction
-        k_req = min(topk, self.D_cap)
-        f2_nsel = 2048
+        # k is bucketed (floor 64, powers of 2) so arbitrary caller topk
+        # values don't mint new compile variants; extra rows returned
+        # beyond the caller's k are harmless. The KERNEL k2 is pinned to
+        # one 256-row value for everyday requests (n ≤ 100 over any s
+        # ≤ topk·2 stays under it), so k2 never multiplies the compile
+        # grid; only genuinely deep pages mint a bigger variant
+        k_req = min(_bucket(max(topk, 1), 64), self.D_cap)
+        k2v = min(max(256, k_req), self.D_cap)
+        # deep paging (TopTree top-X, X ≫ page): start the F2 selection
+        # rung at the requested depth so page-50 doesn't climb a ladder
+        f2_nsel = min(max(2048, _bucket(k_req, 2048)), self.D_cap)
         bmax = self._f2_bmax()
         while f1 or f2:
             t_issue = time.perf_counter()
@@ -898,15 +1055,18 @@ class DeviceIndex:
                 groups.setdefault(self._kappa_of(plans[i], topk),
                                   []).append(i)
             for kappa, idxs in sorted(groups.items()):
-                for a in range(0, len(idxs), 32):  # B buckets: {4, 32}
-                    chunk = idxs[a:a + 32]
+                # big-κ rungs (escalations, deep paging) drop to B=4 so
+                # the [T, P, κ]·B phase-2 intermediates stay bounded
+                step = 32 if kappa <= 32 * KAPPA_FLOOR else 4
+                for a in range(0, len(idxs), step):
+                    chunk = idxs[a:a + step]
                     waves.append(("f1", kappa, chunk, self._run_batch(
                         [plans[i] for i in chunk], kappa,
-                        min(k_req, kappa))))
+                        min(k2v, kappa))))
             for a in range(0, len(f2), bmax):
                 chunk = f2[a:a + bmax]
                 waves.append(("f2", 0, chunk, self._run_batch_f2(
-                    [plans[i] for i in chunk], k_req, f2_nsel)))
+                    [plans[i] for i in chunk], k2v, f2_nsel)))
             g_stats.record_ms("devindex.issue",
                               1000 * (time.perf_counter() - t_issue))
             t_fetch = time.perf_counter()
@@ -918,7 +1078,7 @@ class DeviceIndex:
             f1_next: list[int] = []
             f2_next: list[int] = []
             for (kind, kappa, idxs, _), out in zip(waves, outs):
-                k2 = min(k_req, kappa) if kind == "f1" else k_req
+                k2 = min(k2v, kappa) if kind == "f1" else k2v
                 for row, i in zip(out, idxs):
                     k2p = min(k2, f2_nsel, self.D_cap) if kind == "f2" \
                         else k2
@@ -927,10 +1087,9 @@ class DeviceIndex:
                         k2p >= k_req and scores[k_req - 1] > 0.0) else 0.0
                     if missed > kth * _TIE_TOL:
                         if kind == "f1" and kappa < self.D_cap:
-                            # ≥3 candidate docs shared a block — widen
-                            # the rung and rerun
-                            plans[i].driver_df = min(4 * max(
-                                plans[i].driver_df, kappa), self.D_cap)
+                            # pruning miss — widen the κ rung and rerun
+                            plans[i].kappa_min = min(4 * kappa,
+                                                     self.D_cap)
                             f1_next.append(i)
                             continue
                         if kind == "f2" and f2_nsel < self.D_cap:
@@ -942,6 +1101,78 @@ class DeviceIndex:
             f1, f2 = f1_next, f2_next
             f2_nsel = min(f2_nsel * 4, self.D_cap)
         return results
+
+    def warm(self) -> int:
+        """Precompile the shape variants everyday queries hit (one dummy
+        dispatch each; results discarded) — bench traces showed cold
+        XLA compiles (~20-60 s through the tunnel) landing mid-serving
+        and doubling run-to-run variance. Not exhaustive: deep-paging
+        k2 sizes, terminal escalation rungs, and >64-row plans still
+        compile on first use (rare by construction). Compiles persist
+        in the XLA compilation cache, so warm() after a restart is
+        cheap."""
+        T = T_FLOOR
+        z = np.zeros
+
+        def dummy(ns: int = 1, np_rows: int = 1,
+                  nd: int = 1) -> ResidentPlan:
+            req = z(T, bool)
+            req[0] = True
+            return ResidentPlan(
+                d_slot=z(nd, np.int32), d_group=z(nd, np.int32),
+                d_base=z(nd, np.int32), d_quota=np.ones(nd, np.int32),
+                d_syn=z(nd, np.uint32),
+                s_start=z(ns, np.int32), s_len=np.ones(ns, np.int32),
+                s_group=z(ns, np.int32), s_base=z(ns, np.int32),
+                s_quota=np.ones(ns, np.int32), s_syn=z(ns, np.uint32),
+                s_isbase=np.ones(ns, bool),
+                c_slot=z(1, np.int32), c_dslot=z(1, np.int32),
+                c_group=z(1, np.int32), c_base=z(1, np.int32),
+                c_quota=np.ones(1, np.int32), c_syn=z(1, np.uint32),
+                p_start=z(np_rows, np.int32),
+                p_len=np.ones(np_rows, np.int32),
+                p_group=z(np_rows, np.int32), p_base=z(np_rows, np.int32),
+                p_quota=np.ones(np_rows, np.int32),
+                p_syn=z(np_rows, np.uint32),
+                p_isbase=np.ones(np_rows, bool),
+                freq_weight=np.full(T, 0.5, np.float32),
+                required=req, negative=z(T, bool), scored=req.copy(),
+                counts=req.copy(), table=pad_table(None), qlang=0,
+                matchable=True)
+
+        outs = []
+        k2 = min(256, self.D_cap)
+        shape_grid = ((1, 1), (5, 1), (1, 5), (5, 5), (17, 1))
+        for ns, nd in shape_grid:  # κ=256 rung: B=32 always
+            outs.append(self._run_batch(
+                [dummy(ns=ns, nd=nd)], min(KAPPA_FLOOR, self.D_cap),
+                min(k2, KAPPA_FLOOR)))
+        kap8 = min(KAPPA_FLOOR * 8, self.D_cap)
+        for ns, nd in shape_grid:  # κ=2048 rung, B=8 (≤8 real queries)
+            outs.append(self._run_batch(
+                [dummy(ns=ns, nd=nd)], kap8, min(k2, kap8)))
+        for ns, nd in ((1, 1), (5, 1), (5, 5)):  # κ=2048, B=32
+            outs.append(self._run_batch(
+                [dummy(ns=ns, nd=nd)] * 9, kap8, min(k2, kap8)))
+        kap32 = min(KAPPA_FLOOR * 32, self.D_cap)
+        outs.append(self._run_batch([dummy()], kap32, min(k2, kap32)))
+        outs.append(self._run_batch([dummy()] * 9, kap32,
+                                    min(k2, kap32)))
+        kap128 = min(KAPPA_FLOOR * 128, self.D_cap)
+        outs.append(self._run_batch([dummy()], kap128,
+                                    min(k2, kap128)))
+        for n_sel in (2048, 8192):  # F2 base + first escalation rung
+            for np_rows in (1, 9):
+                p = dummy(np_rows=np_rows)
+                p.p_len[:] = 1
+                outs.append(self._run_batch_f2(
+                    [p], k2, min(n_sel, self.D_cap)))
+                p2 = dummy(np_rows=np_rows)
+                p2.p_len[0] = F2_LPOST_FLOOR + 1  # big-Lp bucket
+                outs.append(self._run_batch_f2(
+                    [p2], k2, min(n_sel, self.D_cap)))
+        jax.device_get(outs)
+        return len(outs)
 
     def _parse_out(self, row, k2: int):
         nm = int(row[0])
@@ -958,29 +1189,59 @@ class DeviceIndex:
             scores[keep], nm)
 
     def _kappa_of(self, p: ResidentPlan, topk: int) -> int:
-        """κ rung for a plan. Selection is top-2-per-block, so κ wants
-        headroom over the driver's doc count (a block holding ≥3
-        candidate docs loses one and triggers the escalation check);
-        two rungs keep the compile-variant count tiny."""
-        need = max(KAPPA_FLOOR, 2 * topk, p.driver_df)
-        for rung in (8 * KAPPA_FLOOR, 32 * KAPPA_FLOOR):
+        """κ rung for a plan.
+
+        Single-scored-group queries get a SPECULATIVE small κ even when
+        the driver matches far more docs: with one group the phase-1
+        bound is the impact itself — nearly the exact score — so the
+        top-κ-by-bound almost always contains the top-k exact and the
+        lossless missed-vs-kth check just passes (escalation covers the
+        rare miss). Phase-2 gather cost is ∝ κ·T·P, so this is the
+        difference between ~9 ms and ~70 ms for a hot single-term
+        query. Multi-group queries rung by driver_df as before: their
+        pair bounds are distance-free (loose), and a small κ would
+        escalate every time."""
+        if int(np.sum(p.counts)) <= 1:
+            # top-MAX_TOP-cut impacts make the single-group bound the
+            # exact score (mod float association): the smallest rung
+            # suffices and phase-2 cost collapses to κ=256 gathers
+            need = max(KAPPA_FLOOR, 2 * topk, p.kappa_min)
+        else:
+            need = max(KAPPA_FLOOR, 2 * topk, p.driver_df, p.kappa_min)
+        for rung in (KAPPA_FLOOR, 8 * KAPPA_FLOOR, 32 * KAPPA_FLOOR):
             if need <= rung:
                 return min(rung, self.D_cap)
         return min(_bucket(need, KAPPA_FLOOR), self.D_cap)
 
     def _f2_bmax(self) -> int:
         """F2 batch cap: full-cube intermediates are ~48 bytes/doc/query
-        ([T,P,D] cube+validity+scores) — bound them to ~768 MB."""
+        ([T,P,D] cube+validity+scores) — bound them to ~1.5 GB (wave
+        RTT is ~100 ms, so doubling B nearly halves F2 wall time)."""
         per_q = 48 * MAX_POSITIONS * self.D_cap
-        return max(4, min(32, (768 << 20) // max(per_q, 1)))
+        return max(4, min(16, (1536 << 20) // max(per_q, 1)))
 
     def _run_batch(self, plans: list[ResidentPlan], kappa: int, k2: int):
-        Rd = _bucket(max([len(p.d_slot) for p in plans] + [1]), RD_FLOOR)
-        Rs = _bucket(max([len(p.s_start) for p in plans] + [1]), RS_FLOOR)
-        Lsp = _bucket(max([int(p.s_len.max()) if len(p.s_len) else 1
-                           for p in plans] + [1]), LSP_FLOOR)
+        # pinned bucket ladders — every (Rd, Rs, κ, B) combination that
+        # everyday queries can hit is finite and enumerable, so warm()
+        # can precompile ALL of them and the measured path never eats a
+        # ~60 s tunnel compile (run-to-run bench variance traced to
+        # exactly that)
+        mrd = max([len(p.d_slot) for p in plans] + [1])
+        Rd = 4 if mrd <= 4 else (16 if mrd <= 16 else _bucket(mrd, 64))
+        mrs = max([len(p.s_start) for p in plans] + [1])
+        Rs = 4 if mrs <= 4 else (16 if mrs <= 16 else _bucket(mrs, 64))
+        Lsp = LSP_FLOOR  # runs chunk at LSP_MAX == LSP_FLOOR (plan)
         T = max(len(p.required) for p in plans)
-        B = 32  # ONE B bucket — compile variants are ~60s each
+        # B buckets: phase-2 gathers cost ∝ B·κ INCLUDING pad lanes, so
+        # a κ≥2048 wave with few real queries pads to 8, not 32 (the
+        # κ=2048+ rungs usually hold the minority of a batch); the
+        # terminal rungs drop to B=4 to bound [T, P, κ]·B memory
+        if kappa > 32 * KAPPA_FLOOR:
+            B = 4
+        elif kappa >= 8 * KAPPA_FLOOR and len(plans) <= 8:
+            B = 8
+        else:
+            B = 32
 
         def pad_plan(p: ResidentPlan | None):
             if p is None:
@@ -1027,8 +1288,9 @@ class DeviceIndex:
 
     def _run_batch_f2(self, plans: list[ResidentPlan], k2: int,
                       n_sel: int):
-        Rc = _bucket(max([len(p.c_slot) for p in plans] + [1]), RC_FLOOR)
-        Rp = _bucket(max([len(p.p_start) for p in plans] + [1]), RP_FLOOR)
+        Rc = _bucket(max([len(p.c_slot) for p in plans] + [1]), 8)
+        mrp = max([len(p.p_start) for p in plans] + [1])
+        Rp = 8 if mrp <= 8 else (32 if mrp <= 32 else _bucket(mrp, 64))
         maxlen = max([int(p.p_len.max()) if len(p.p_len) else 1
                       for p in plans] + [1])
         Lp = F2_LPOST_FLOOR if maxlen <= F2_LPOST_FLOOR else F2_SCATTER_MAX
@@ -1113,11 +1375,17 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
 
         # ---- phase 1: group upper bounds over the full doc axis,
         # base and delta separated so dead docs mask only the base ----
+        # dense rows come out of [V, D] via EXPLICIT dynamic slices:
+        # a traced-index row gather ([Rd, D] in one op) lowers to
+        # per-element gather on TPU (~60 Melem/s — measured to dominate
+        # the wave); a dynamic slice is a bandwidth-speed row copy
         ubb = jnp.zeros((T, D), jnp.float32)
-        dimp = d_dense_imp[jnp.clip(d_slot, 0, V - 1)]        # [Rd, D]
         dgate = (d_slot >= 0)
         for r in range(Rd):
-            contrib = jnp.where(dgate[r], dimp[r], 0.0)
+            row = jax.lax.dynamic_index_in_dim(
+                d_dense_imp, jnp.clip(d_slot[r], 0, V - 1), axis=0,
+                keepdims=False)
+            contrib = jnp.where(dgate[r], row, 0.0)
             ubb = ubb + jnp.where((d_group[r] == t_ax)[:, None],
                                   contrib[None, :], 0.0)
         # sparse rows: one fused contiguous gather + bounded scatter-add
@@ -1174,10 +1442,12 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
         ubfinal = jnp.where(alive, ubmin * mult * 1.00001, 0.0)
         nm = jnp.sum(alive)
 
-        cval, cand = jax.lax.approx_max_k(ubfinal, kappa,
-                                  recall_target=0.99)
-        selmask = jnp.zeros((D,), bool).at[cand].set(True)
-        ub_missed = jnp.max(jnp.where(selmask, 0.0, ubfinal))
+        # candidate selection via top-8-per-block max-reduces:
+        # approx_max_k/top_k lower to sort-like programs costing
+        # hundreds of ms on a [B, 131072] axis (measured ~190 ms fixed
+        # per wave); _block_topn is ~2 ms and its missed_max feeds the
+        # SAME lossless escalation check
+        cval, cand, ub_missed = _block_topn(ubfinal, kappa)
 
         # ---- phase 2: exact scoring of the κ candidates ----
         dead_c = d_dead[cand]                                 # [κ]
@@ -1332,7 +1602,7 @@ def _full_cube(d_payload, d_pdoc, d_pocc, d_cube, d_dense_rsp,
         # block-winners then a cheap exact top-k over the winners;
         # escalation reruns with 4x the blocks, terminal at n_sel == D
         # where every doc is selected and missed is exactly 0
-        w_vals, w_idx, missed = _block_top2(final, min(n_sel, D))
+        w_vals, w_idx, missed = _block_topn(final, min(n_sel, D))
         ts, tl = jax.lax.top_k(w_vals, min(k2, min(n_sel, D)))
         ti = w_idx[tl]
         return jnp.concatenate([

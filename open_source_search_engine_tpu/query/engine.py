@@ -68,8 +68,7 @@ def build_results(get_doc, docids, scores, plan: QueryPlan, *,
     (Msg51: at most MAX_PER_SITE per site, rest hidden), build summaries.
 
     ``get_doc`` is docid → titlerec dict (routes to the owning shard in
-    the mesh path). Returns (results, number hidden by cluster/dedup).
-    """
+    the mesh path). Returns (results, number hidden by cluster/dedup)."""
     from . import summary as summary_mod
 
     words = [g.display for g in plan.scored_groups]
@@ -112,18 +111,79 @@ def build_results(get_doc, docids, scores, plan: QueryPlan, *,
                     clustered += 1
                     continue
                 per_site[r.site] = seen + 1
-            if with_snippets:
-                r.snippet = summary_mod.make_summary(
-                    rec.get("text", ""), words)
+        if rec and with_snippets:
+            r.snippet = summary_mod.make_summary(
+                rec.get("text", ""), words)
         results.append(r)
     return results, clustered
+
+
+#: PostQueryRerank window: only the top PQR_SCAN merged results are
+#: reranked (reference m_pqr_docsToScan) — the window is FIXED by rank,
+#: not by the requested page, so pagination stays consistent: every
+#: page request reranks the same top-48 and slices its own rows out
+PQR_SCAN = 48
+
+
+def apply_pqr(results, conf=None, qlang: int = 0, langid_of=None) -> None:
+    """PostQueryRerank over one result window (PostQueryRerank.cpp
+    role; factors from the collection conf, defaults when no conf is
+    in reach — the cluster client)."""
+    from .rerank import post_query_rerank
+    if conf is not None and not conf.pqr_enabled:
+        return
+    kw = {}
+    if conf is not None:
+        kw = dict(lang_demote=conf.pqr_lang_demote,
+                  site_demote=conf.pqr_site_demote,
+                  depth_demote=conf.pqr_depth_demote)
+    window = results[:PQR_SCAN]
+    post_query_rerank(window, qlang, langid_of=langid_of, **kw)
+    results[:PQR_SCAN] = window
+
+
+def _coll_langid_of(coll: Collection):
+    """Docid → langid via a clusterdb point read (host path analog of
+    DeviceIndex.langid_of — same records, so flat/resident parity
+    holds under the PQR language rule)."""
+    from ..index import clusterdb as cdb
+    from ..index import titledb
+
+    def f(docid: int) -> int:
+        lst = coll.clusterdb.get_list(titledb.start_key(docid),
+                                      titledb.end_key(docid))
+        if not len(lst):
+            return 0
+        return int(cdb.unpack_key(lst.keys)["langid"][-1])
+    return f
+
+
+def finish_page(results, *, offset: int, topk: int, conf=None,
+                qlang: int = 0, langid_of=None, get_doc=None,
+                words=None, with_snippets: bool = True):
+    """The shared post-merge tail every search path runs: PQR over the
+    fixed top window → slice the requested page → build summaries for
+    the page rows only (deep pages must not pay snippets for the rows
+    they skip)."""
+    from . import summary as summary_mod
+    apply_pqr(results, conf, qlang, langid_of=langid_of)
+    page = results[offset:offset + topk]
+    if with_snippets and get_doc is not None:
+        for r in page:
+            if not r.snippet:
+                rec = get_doc(int(r.docid))
+                if rec:
+                    r.snippet = summary_mod.make_summary(
+                        rec.get("text", ""), words or [])
+    return page
 
 
 def search(coll: Collection, q: str | QueryPlan, *, topk: int = 10,
            lang: int = 0, max_docs_per_pass: int = 1 << 16,
            with_snippets: bool = True,
-           site_cluster: bool = True) -> SearchResults:
-    """Execute a query against one collection (single shard)."""
+           site_cluster: bool = True, offset: int = 0) -> SearchResults:
+    """Execute a query against one collection (single shard).
+    ``offset`` = deep-paging start row (reference ``s=``)."""
     plan = q if isinstance(q, QueryPlan) else compile_query(q, lang=lang)
     raw = plan.raw
 
@@ -135,16 +195,17 @@ def search(coll: Collection, q: str | QueryPlan, *, topk: int = 10,
     # re-score with a larger k (the Msg40 recall loop, Msg40.cpp:2117,
     # as over-fetch per SURVEY §7 hard part (c)); the sharded path has
     # the same loop around its merge
-    k = max(topk, 64)
+    want = max(topk + offset, PQR_SCAN)
+    k = max(want, 64)
     while True:
         # docid-range multipass: fetch+intersect once, then score
         # candidate slices, merging top-k across passes
         all_docids: list[np.ndarray] = []
         all_scores: list[np.ndarray] = []
         total = 0
-        for offset in range(0, len(prep.cand), max_docs_per_pass):
+        for doc_off in range(0, len(prep.cand), max_docs_per_pass):
             with g_stats.timed("query.pack"):
-                pq = pack_pass(prep, doc_offset=offset,
+                pq = pack_pass(prep, doc_offset=doc_off,
                                max_docs=max_docs_per_pass)
             if pq is None:
                 break
@@ -164,14 +225,20 @@ def search(coll: Collection, q: str | QueryPlan, *, topk: int = 10,
         with g_stats.timed("query.results"):
             results, clustered = build_results(
                 lambda d: docproc.get_document(coll, docid=d),
-                docids[order], scores[order], plan, topk=topk,
-                with_snippets=with_snippets, site_cluster=site_cluster)
-        if (len(results) >= topk or clustered == 0
+                docids[order], scores[order], plan, topk=want,
+                with_snippets=False, site_cluster=site_cluster)
+        if (len(results) >= want or clustered == 0
                 or k >= len(prep.cand)):
             break
         k *= 4
+    page = finish_page(
+        results, offset=offset, topk=topk, conf=coll.conf,
+        qlang=plan.lang, langid_of=_coll_langid_of(coll),
+        get_doc=lambda d: docproc.get_document(coll, docid=d),
+        words=[g.display for g in plan.scored_groups],
+        with_snippets=with_snippets)
     return SearchResults(
-        query=raw, total_matches=total, results=results,
+        query=raw, total_matches=total, results=page,
         clustered=clustered,
         suggestion=_suggest(coll, plan) if total == 0 else None)
 
@@ -198,7 +265,8 @@ def get_device_index(coll: Collection):
 
 def search_device_batch(coll: Collection, queries, *, topk: int = 10,
                         lang: int = 0, with_snippets: bool = True,
-                        site_cluster: bool = True) -> list[SearchResults]:
+                        site_cluster: bool = True, offset: int = 0
+                        ) -> list[SearchResults]:
     """Batched resident-index search: B queries in one device round trip
     (the TPU throughput mode — vmap over queries, SURVEY §7.8)."""
     di = get_device_index(coll)
@@ -206,17 +274,24 @@ def search_device_batch(coll: Collection, queries, *, topk: int = 10,
              for q in queries]
     g_stats.count("query", len(plans))
     with g_stats.timed("query.device_batch"):
-        raw = di.search_batch(plans, topk=max(topk * 2, 64), lang=lang)
+        raw = di.search_batch(plans, topk=max((topk + offset) * 2, 64),
+                              lang=lang)
     out = []
     t_res = time.perf_counter()
     for plan, (docids, scores, n_matched) in zip(plans, raw):
         results, clustered = build_results(
             lambda d: docproc.get_document(coll, docid=d),
-            docids, scores, plan, topk=topk,
-            with_snippets=with_snippets, site_cluster=site_cluster,
+            docids, scores, plan, topk=max(topk + offset, PQR_SCAN),
+            with_snippets=False, site_cluster=site_cluster,
             site_of=di.sitehash_of)
+        page = finish_page(
+            results, offset=offset, topk=topk, conf=coll.conf,
+            qlang=plan.lang, langid_of=di.langid_of,
+            get_doc=lambda d: docproc.get_document(coll, docid=d),
+            words=[g.display for g in plan.scored_groups],
+            with_snippets=with_snippets)
         out.append(SearchResults(
-            query=plan.raw, total_matches=n_matched, results=results,
+            query=plan.raw, total_matches=n_matched, results=page,
             clustered=clustered,
             suggestion=_suggest(coll, plan) if n_matched == 0 else None))
     g_stats.record_ms(

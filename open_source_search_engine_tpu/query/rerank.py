@@ -1,0 +1,70 @@
+"""PostQueryRerank — final demotion pass over the gathered top results.
+
+Reference: ``PostQueryRerank.cpp`` (Msg40 runs it over the first
+``m_pqr_docsToScan`` results after the merge): a set of multiplicative
+*demotion factors* — foreign language/country, query terms appearing
+only as a subphrase, too many results from one site/domain, paywall-ish
+urls, etc. — each controlled by a per-collection parm, rescales the
+final scores and the page is re-sorted.
+
+Ours keeps the same shape (multiplicative factors over the merged top
+page, host-side — the candidates are ≤ a page, so this is list work,
+not device work) with the three demotions that still carry their
+weight on a modern corpus:
+
+* ``pqr_lang`` — foreign-language demotion beyond the kernel's
+  SAMELANGMULT boost (reference m_pqr_demFactForeignLanguage);
+* ``pqr_site`` — the k-th result from one registrable domain demotes
+  geometrically (m_pqr_demFactSubPhrase family's diversity role —
+  softer than Msg51's hard 2-per-site clustering, and applied even
+  when clustering is off);
+* ``pqr_paths`` — deep-path urls demote slightly when scores are
+  close (m_pqr_demFactPageSize/QualityScore spirit: prefer canonical
+  pages over deep leaf urls at equal relevance).
+
+Factors are in (0, 1]; 1.0 disables a rule. Stable re-sort preserves
+the original order for untouched results.
+"""
+
+from __future__ import annotations
+
+from ..utils.url import normalize
+
+
+def post_query_rerank(results, qlang: int = 0, *,
+                      lang_demote: float = 0.8,
+                      site_demote: float = 0.85,
+                      depth_demote: float = 0.97,
+                      langid_of=None) -> int:
+    """Rescale ``results`` (list of engine.Result) in place and stably
+    re-sort by the adjusted scores. Returns how many results moved.
+
+    ``langid_of``: optional docid → langid lookup (clusterdb column);
+    without it the language rule is skipped — the titlerec fetch isn't
+    worth it for a demotion."""
+    if not results:
+        return 0
+    orig_order = [r.docid for r in results]
+    per_domain: dict[str, int] = {}
+    for r in results:
+        f = 1.0
+        try:
+            u = normalize(r.url)
+            dom = u.domain
+            depth = max(len([s for s in u.path.split("/") if s]) - 1, 0)
+        except Exception:  # noqa: BLE001 — junk urls stay untouched
+            dom, depth = "", 0
+        if dom:
+            seen = per_domain.get(dom, 0)
+            per_domain[dom] = seen + 1
+            if seen:  # 2nd result of a domain × f, 3rd × f², ...
+                f *= site_demote ** seen
+        if depth:
+            f *= depth_demote ** min(depth, 4)
+        if langid_of is not None and qlang:
+            dl = langid_of(r.docid)
+            if dl and dl != qlang:
+                f *= lang_demote
+        r.score *= f
+    results.sort(key=lambda r: -r.score)  # timsort: stable for ties
+    return sum(1 for r, d in zip(results, orig_order) if r.docid != d)

@@ -24,15 +24,98 @@ from __future__ import annotations
 import html as html_mod
 import json
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
 
 from ..index.collection import CollectionDb
 from ..query import engine
 from ..query.summary import highlight
 from ..utils.log import get_logger
+from ..utils.parms import Conf
 
 log = get_logger("http")
+
+
+class QueryBatcher:
+    """Msg40 micro-batching: concurrent /search requests coalesce into
+    ONE device dispatch (vmap over the query axis — SURVEY §7.8's
+    throughput mode, which a one-lock-per-request server can never
+    reach: its ceiling is 1/latency qps regardless of device speed).
+
+    Requests enqueue and wait; a single worker drains the queue in
+    same-parameter batches of ≤ MAX_B. Errors propagate to every waiter
+    of the failing batch."""
+
+    MAX_B = 32
+    WINDOW_S = 0.002  # brief collect window once a first query arrives
+
+    def __init__(self, run_batch):
+        #: run_batch((coll_name, topk, offset), [queries]) → [results]
+        self._run_batch = run_batch
+        self._cv = threading.Condition()
+        self._queue: list[tuple[tuple, str, dict]] = []
+        self._alive = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="query-batcher")
+        self._thread.start()
+
+    @property
+    def alive(self) -> bool:
+        return self._alive
+
+    def stop(self) -> None:
+        """Kill the worker; fail queued waiters fast (they'd otherwise
+        hang to their own timeout)."""
+        with self._cv:
+            self._alive = False
+            for e in self._queue:
+                e[2]["err"] = RuntimeError("query batcher stopped")
+            self._queue.clear()
+            self._cv.notify_all()
+
+    def search(self, key: tuple, q: str, timeout: float = 60.0):
+        holder: dict = {}
+        with self._cv:
+            self._queue.append((key, q, holder))
+            self._cv.notify_all()
+            deadline = time.monotonic() + timeout
+            while "res" not in holder and "err" not in holder:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError("query batcher timeout")
+                self._cv.wait(timeout=left)
+        if "err" in holder:
+            raise holder["err"]
+        return holder["res"]
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while self._alive and not self._queue:
+                    self._cv.wait()
+                if not self._alive:
+                    return
+            time.sleep(self.WINDOW_S)  # let concurrent arrivals land
+            with self._cv:
+                if not self._queue:  # stop() drained it mid-window
+                    continue
+                key = self._queue[0][0]
+                batch = [e for e in self._queue if e[0] == key][: self.MAX_B]
+                for e in batch:
+                    self._queue.remove(e)
+            try:
+                res = self._run_batch(key, [e[1] for e in batch])
+                with self._cv:
+                    for e, r in zip(batch, res):
+                        e[2]["res"] = r
+                    self._cv.notify_all()
+            except Exception as exc:  # noqa: BLE001 — waiters must wake
+                with self._cv:
+                    for e in batch:
+                        e[2]["err"] = exc
+                    self._cv.notify_all()
 
 
 def _xml_escape(s: str) -> str:
@@ -93,31 +176,102 @@ class SearchHTTPServer:
     reference's public endpoints."""
 
     def __init__(self, base_dir, host: str = "127.0.0.1", port: int = 8000,
-                 sharded=None, spider=None, cluster=None):
+                 sharded=None, spider=None, cluster=None,
+                 conf: Conf | None = None):
         self.colldb = CollectionDb(base_dir)
         self.sharded = sharded  # ShardedCollection | None (in-process mesh)
         self.cluster = cluster  # ClusterClient | None (multi-process plane)
         self.spider = spider    # spider queue hook (addurl)
         self.host = host
         self.port = port
+        self.conf = conf or Conf()
+        gbconf = Path(base_dir) / "gb.conf"
+        if conf is None and gbconf.exists():
+            self.conf.load(gbconf)
         self.stats = {"queries": 0, "injects": 0, "addurls": 0,
-                      "gets": 0, "errors": 0}
+                      "gets": 0, "errors": 0, "auth_denied": 0}
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         # the Rdb/MemTable/caches are single-writer structures (the
         # reference's whole core is single-threaded event-driven,
         # SURVEY §1); the threaded accept plane serializes at this lock
         self._lock = threading.RLock()
+        #: /search micro-batching (flat device path only; the sharded
+        #: and cluster planes batch at their own layers)
+        self._batcher = QueryBatcher(self._run_device_batch)
+        #: statsdb persistence (reference Statsdb: an on-disk ring of
+        #: timestamped metric samples behind PagePerf graphs)
+        self._statsdb_path = Path(base_dir) / "statsdb.jsonl"
+        self._sampler: threading.Thread | None = None
+        self._stop_sampling = threading.Event()
+        #: AutoBan (AutoBan.cpp): per-IP query rate limiting. hits =
+        #: ip → recent request timestamps; banned = ip → ban expiry
+        self._ab_lock = threading.Lock()
+        self._ab_hits: dict[str, list[float]] = {}
+        self._ab_banned: dict[str, float] = {}
+
+    BAN_COOLDOWN_S = 60.0
+
+    def _autobanned(self, ip: str, limit_qps: int) -> bool:
+        """Sliding 1-second window per client IP; exceeding the limit
+        bans the IP for BAN_COOLDOWN_S (reference AutoBan bans abusive
+        query sources and returns an error page)."""
+        if not limit_qps or not ip:
+            return False
+        now = time.monotonic()
+        with self._ab_lock:
+            until = self._ab_banned.get(ip, 0.0)
+            if until > now:
+                return True
+            hits = self._ab_hits.setdefault(ip, [])
+            hits.append(now)
+            del hits[: max(0, len(hits) - 4 * limit_qps)]
+            recent = [t for t in hits if t > now - 1.0]
+            if len(recent) > limit_qps:
+                self._ab_banned[ip] = now + self.BAN_COOLDOWN_S
+                if len(self._ab_banned) > 4096:
+                    self._ab_banned = {
+                        k: v for k, v in self._ab_banned.items()
+                        if v > now}
+                log.warning("autoban: %s exceeded %d qps", ip,
+                            limit_qps)
+                return True
+            if len(self._ab_hits) > 8192:  # bound the tracking table
+                self._ab_hits = {ip: hits}
+        return False
+
+    def _run_device_batch(self, key: tuple, queries: list[str]):
+        cname, topk, offset = key
+        with self._lock:
+            return engine.search_device_batch(
+                self.colldb.get(cname), queries, topk=topk,
+                offset=offset)
+
+    def _authorized(self, query: dict) -> bool:
+        """Master-password gate for /admin (Conf::m_masterPwds;
+        reference PageLogin). Empty password = open instance."""
+        pwd = self.conf.master_password
+        return (not pwd) or query.get("pwd", "") == pwd
 
     # --- request handling -------------------------------------------------
 
     def handle(self, method: str, path: str, query: dict,
-               body: bytes) -> tuple[int, str, str]:
+               body: bytes, client_ip: str = "") -> tuple[int, str, str]:
         """Route one request → (status, payload, content_type).
         The Pages.cpp s_pages[] table, as a method."""
         try:
             if path == "/":
                 return 200, self._page_root(), "text/html"
+            if path == "/search":
+                limit = int(self._coll(query).conf.autoban_qps)
+                if self._autobanned(client_ip, limit):
+                    return 429, json.dumps(
+                        {"error": "query rate limit (autoban)"}), \
+                        "application/json"
+                # NOT under the global lock: the micro-batcher would
+                # deadlock (its worker takes the lock), and holding it
+                # per-request caps the plane at 1/latency qps
+                return self._page_search(query)
             with self._lock:
                 return self._route(method, path, query, body)
         except Exception as e:  # noqa: BLE001 — server must not die
@@ -127,14 +281,22 @@ class SearchHTTPServer:
 
     def _route(self, method: str, path: str, query: dict,
                body: bytes) -> tuple[int, str, str]:
-        if path == "/search":
-            return self._page_search(query)
         if path == "/get":
             return self._page_get(query)
         if path == "/inject":
             return self._page_inject(query, body)
         if path == "/addurl":
             return self._page_addurl(query)
+        if path.startswith("/admin") and not self._authorized(query):
+            self.stats["auth_denied"] += 1
+            return 401, json.dumps({"error": "bad or missing pwd"}), \
+                "application/json"
+        if path in ("/admin", "/admin/"):
+            return 200, self._page_admin_index(query), "text/html"
+        if path == "/admin/profiler":
+            return self._page_profiler(query)
+        if path == "/admin/graph":
+            return 200, self._page_graph(), "image/svg+xml"
         if path == "/admin/stats":
             stats = dict(self.stats)
             # corrupt-run quarantine state (Msg5 error correction)
@@ -177,15 +339,34 @@ class SearchHTTPServer:
             return 400, json.dumps({"error": "missing q"}), \
                 "application/json"
         n = min(int(query.get("n", 10)), 100)
+        # deep paging: first result number (reference PageResults s=),
+        # bounded so a hostile s can't force a corpus-sized top-k
+        s = min(max(int(query.get("s", 0)), 0), 100000)
         fmt = query.get("format", "json")
         self.stats["queries"] += 1
         if self.cluster is not None:
-            res = self.cluster.search(q, topk=n)
+            res = self.cluster.search(q, topk=n, offset=s,
+                                      conf=self._coll(query).conf)
         elif self.sharded is not None:
             from ..parallel import sharded_search
-            res = sharded_search(self.sharded, q, topk=n)
+            with self._lock:
+                res = sharded_search(self.sharded, q, topk=n, offset=s)
+        elif self.conf.serve_device:
+            # resident-index path through the micro-batcher: concurrent
+            # requests share one vmapped dispatch
+            try:
+                res = self._batcher.search(
+                    (query.get("c", "main"), n, s), q)
+            except Exception as e:  # noqa: BLE001 — degrade, don't 500
+                log.warning("device search failed (%s); host fallback",
+                            e)
+                with self._lock:
+                    res = engine.search(self._coll(query), q, topk=n,
+                                        offset=s)
         else:
-            res = engine.search(self._coll(query), q, topk=n)
+            with self._lock:
+                res = engine.search(self._coll(query), q, topk=n,
+                                    offset=s)
         payload, ctype = render_results(res, fmt)
         return 200, payload, ctype
 
@@ -276,6 +457,118 @@ class SearchHTTPServer:
             "table": table,
         }), "application/json"
 
+    # --- admin HTML (Pages.cpp admin page set) ---------------------------
+
+    def _page_admin_index(self, query: dict) -> str:
+        pwd = query.get("pwd", "")
+        sfx = f"?pwd={urllib.parse.quote(pwd)}" if pwd else ""
+        links = "".join(
+            f'<li><a href="/admin/{p}{sfx}">{p}</a></li>'
+            for p in ("stats", "hosts", "perf", "parms", "profiler",
+                      "graph"))
+        rows = "".join(f"<tr><td>{k}</td><td>{v}</td></tr>"
+                       for k, v in self.stats.items())
+        colls = ", ".join(self.colldb.names())
+        return (f"<html><head><title>gb admin</title></head><body>"
+                f"<h1>admin</h1><p>collections: {colls}</p>"
+                f"<ul>{links}</ul><table border=1>{rows}</table>"
+                f"</body></html>")
+
+    def _page_profiler(self, query: dict) -> tuple[int, str, str]:
+        """Per-stage timing table (the Profiler.cpp role, realized as
+        the engine's own stage spans: prepare/pack/score/device/
+        results/waves)."""
+        from ..utils.stats import g_stats
+        snap = g_stats.snapshot()
+        if query.get("format") == "json":
+            return 200, json.dumps(snap["latencies"]), "application/json"
+        rows = "".join(
+            f"<tr><td>{html_mod.escape(k)}</td><td>{v['count']}</td>"
+            f"<td>{v['avg_ms']:.1f}</td><td>{v['p50_ms']:.1f}</td>"
+            f"<td>{v['p99_ms']:.1f}</td><td>{v['max_ms']:.1f}</td></tr>"
+            for k, v in sorted(snap["latencies"].items()))
+        return 200, (
+            "<html><head><title>profiler</title></head><body>"
+            "<h1>stage timings (ms)</h1><table border=1>"
+            "<tr><th>stage</th><th>n</th><th>avg</th><th>p50</th>"
+            f"<th>p99</th><th>max</th></tr>{rows}</table>"
+            "</body></html>"), "text/html"
+
+    def _page_graph(self) -> str:
+        """qps/latency time-series as inline SVG (PagePerf/Statsdb
+        graphs without image deps)."""
+        from ..utils.stats import g_stats
+        series = g_stats.series(last_s=3600)
+        w, h = 600, 160
+        if not series:
+            return (f'<svg xmlns="http://www.w3.org/2000/svg" '
+                    f'width="{w}" height="{h}"><text x="10" y="20">'
+                    f"no samples yet</text></svg>")
+        t0, t1 = series[0][0], series[-1][0]
+        span = max(t1 - t0, 1.0)
+
+        def poly(metric: str, color: str) -> str:
+            pts = [(t, m.get(metric)) for t, m in series
+                   if m.get(metric) is not None]
+            if not pts:
+                return ""
+            top = max(v for _, v in pts) or 1.0
+            xy = " ".join(
+                f"{10 + (t - t0) / span * (w - 20):.1f},"
+                f"{h - 20 - v / top * (h - 40):.1f}" for t, v in pts)
+            return (f'<polyline fill="none" stroke="{color}" '
+                    f'points="{xy}"/>'
+                    f'<text x="12" y="{h - 6}" fill="{color}" '
+                    f'font-size="10">{metric} (max {top:.1f})</text>')
+        return (f'<svg xmlns="http://www.w3.org/2000/svg" width="{w}" '
+                f'height="{h}" style="background:#fff">'
+                + poly("qps", "#1f77b4") + poly("p50_ms", "#d62728")
+                + "</svg>")
+
+    # --- statsdb persistence (Statsdb.cpp sample ring) -------------------
+
+    def _sample_loop(self) -> None:
+        from ..utils.stats import g_stats
+        self._lines_written = 0
+        last_q = self.stats["queries"]
+        last_t = time.monotonic()
+        while not self._stop_sampling.wait(10.0):
+            now = time.monotonic()
+            dq = self.stats["queries"] - last_q
+            qps = dq / max(now - last_t, 1e-9)
+            last_q, last_t = self.stats["queries"], now
+            snap = g_stats.snapshot()["latencies"].get(
+                "query.device_batch") or {}
+            g_stats.sample(qps=round(qps, 2),
+                           p50_ms=round(snap.get("p50_ms", 0.0), 1))
+            try:
+                with open(self._statsdb_path, "a",
+                          encoding="utf-8") as fh:
+                    fh.write(json.dumps(
+                        [time.time(), {"qps": round(qps, 2)}]) + "\n")
+                self._lines_written += 1
+                if self._lines_written >= 512:  # it IS a ring: rotate
+                    tail = self._statsdb_path.read_text(
+                        encoding="utf-8").splitlines()[-2000:]
+                    self._statsdb_path.write_text(
+                        "\n".join(tail) + "\n", encoding="utf-8")
+                    self._lines_written = 0
+            except OSError:
+                pass
+
+    def _load_statsdb(self) -> None:
+        from ..utils.stats import g_stats
+        if not self._statsdb_path.exists():
+            return
+        try:
+            lines = self._statsdb_path.read_text(
+                encoding="utf-8").splitlines()[-500:]
+            for line in lines:
+                t, m = json.loads(line)
+                g_stats.timeseries.append((t, m))
+        except Exception:  # noqa: BLE001 — torn tail line etc.
+            pass
+
     def _page_hosts(self) -> str:
         """Shard/cluster map (PageHosts.cpp)."""
         if self.sharded is None:
@@ -303,7 +596,8 @@ class SearchHTTPServer:
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 status, payload, ctype = outer.handle(
-                    method, parsed.path, query, body)
+                    method, parsed.path, query, body,
+                    client_ip=self.client_address[0])
                 data = payload.encode("utf-8")
                 self.send_response(status)
                 self.send_header("Content-Type", ctype + "; charset=utf-8")
@@ -322,9 +616,18 @@ class SearchHTTPServer:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
+        if not self._batcher.alive:  # stop()/start() cycle
+            self._batcher = QueryBatcher(self._run_device_batch)
+        self._load_statsdb()
+        self._stop_sampling.clear()
+        self._sampler = threading.Thread(target=self._sample_loop,
+                                         daemon=True, name="statsdb")
+        self._sampler.start()
         log.info("http server on %s:%d", self.host, self.port)
 
     def stop(self) -> None:
+        self._stop_sampling.set()
+        self._batcher.stop()
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()

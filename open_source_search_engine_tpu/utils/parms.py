@@ -56,6 +56,8 @@ PARMS: list[Parm] = [
     _p("spider_enabled", "se", bool, True, GLOBAL, "master spider switch (Conf::m_spideringEnabled)"),
     _p("query_max_terms", "qmax", int, 64, GLOBAL, "max query terms (reference ABS_MAX_QUERY_TERMS=9000, Query.h:43; ours is the padded device width)"),
     _p("dns_servers", "dns", str, "", GLOBAL, "DNS resolver ips (Conf dns parms)"),
+    _p("master_password", "mpwd", str, "", GLOBAL, "admin master password; empty = open (Conf::m_masterPwds, PageLogin)", broadcast=False),
+    _p("serve_device", "sdev", bool, True, GLOBAL, "serve /search from the HBM-resident index with micro-batching (SURVEY §7.8 throughput mode)"),
     _p("merge_quiet_hours", "mergehours", str, "", GLOBAL, "DailyMerge window (DailyMerge.h:11)"),
     # --- per-collection (coll.conf / CollectionRec) ---
     _p("docs_wanted", "n", int, 10, COLL, "results per page (SearchInput 'n')"),
@@ -67,6 +69,11 @@ PARMS: list[Parm] = [
     _p("lang_weight", "langw", float, 20.0, COLL, "same-language score boost (Posdb.cpp SAMELANGMULT)"),
     _p("title_max_len", "tml", int, 80, COLL, "title truncation (Title.cpp)"),
     _p("summary_excerpts", "ns", int, 3, COLL, "summary excerpt count (Summary.h)"),
+    _p("pqr_enabled", "pqr", bool, True, COLL, "post-query rerank pass (PostQueryRerank.cpp)"),
+    _p("pqr_lang_demote", "pqrlang", float, 0.8, COLL, "foreign-language demotion factor (m_pqr_demFactForeignLanguage)"),
+    _p("pqr_site_demote", "pqrsite", float, 0.85, COLL, "per-extra-result same-domain demotion (PQR diversity role)"),
+    _p("pqr_depth_demote", "pqrdepth", float, 0.97, COLL, "url path-depth demotion (prefer canonical pages)"),
+    _p("autoban_qps", "abqps", int, 0, COLL, "per-IP query rate limit, 0 = off (AutoBan.cpp)"),
     _p("summary_max_len", "sml", int, 180, COLL, "summary length (Summary.h)"),
 ]
 

@@ -1,0 +1,119 @@
+"""Admin plane: master-password auth, HTML pages, profiler, statsdb
+persistence, and the /search micro-batcher.
+
+Reference: Users/PageLogin master passwords (``Conf::m_masterPwds``),
+Pages.cpp admin set, Profiler, Statsdb sample ring behind PagePerf.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from open_source_search_engine_tpu.build import docproc
+from open_source_search_engine_tpu.serve.server import (QueryBatcher,
+                                                        SearchHTTPServer)
+from open_source_search_engine_tpu.utils.parms import Conf
+
+
+@pytest.fixture
+def srv(tmp_path):
+    s = SearchHTTPServer(tmp_path, port=0)
+    coll = s.colldb.get("main")
+    for i in range(6):
+        docproc.index_document(
+            coll, f"http://a{i % 3}.test/p{i}",
+            f"<html><title>t{i}</title><body><p>admin corpus words "
+            f"number{i}</p></body></html>")
+    s.start()
+    yield s
+    s.stop()
+
+
+def _get(srv, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{srv._httpd.server_port}{path}")
+
+
+def test_admin_open_when_no_password(srv):
+    assert _get(srv, "/admin/stats").status == 200
+    html = _get(srv, "/admin/").read().decode()
+    assert "profiler" in html and "<table" in html
+
+
+def test_admin_requires_password_when_set(srv):
+    srv.conf.master_password = "sekrit"
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(srv, "/admin/stats")
+    assert e.value.code == 401
+    assert _get(srv, "/admin/stats?pwd=sekrit").status == 200
+    # public pages stay open (the reference only gates admin)
+    assert _get(srv, "/search?q=admin+corpus").status == 200
+
+
+def test_profiler_page_lists_stages(srv):
+    _get(srv, "/search?q=admin+corpus&format=json").read()
+    body = _get(srv, "/admin/profiler").read().decode()
+    assert "stage timings" in body
+    js = json.loads(_get(srv, "/admin/profiler?format=json").read())
+    assert any(k.startswith("query.") for k in js)
+
+
+def test_graph_svg(srv):
+    body = _get(srv, "/admin/graph").read().decode()
+    assert body.startswith("<svg")
+
+
+def test_search_uses_device_batcher(srv):
+    out = json.loads(
+        _get(srv, "/search?q=admin+corpus&format=json").read())
+    assert out["totalMatches"] == 6
+    # concurrent queries coalesce and all answer correctly
+    results = {}
+
+    def one(i):
+        r = json.loads(_get(
+            srv, f"/search?q=admin+corpus+number{i}&format=json").read())
+        results[i] = r["totalMatches"]
+    ts = [threading.Thread(target=one, args=(i,)) for i in range(6)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert all(results[i] >= 1 for i in range(6))
+
+
+def test_batcher_propagates_errors():
+    def boom(key, queries):
+        raise RuntimeError("kernel on fire")
+    b = QueryBatcher(boom)
+    with pytest.raises(RuntimeError, match="kernel on fire"):
+        b.search(("main", 10, 0), "q")
+    b.stop()
+
+
+def test_statsdb_persists_and_reloads(tmp_path):
+    s = SearchHTTPServer(tmp_path, port=0)
+    s.start()
+    # force a couple of samples through the ring + file
+    s._stop_sampling.set()
+    from open_source_search_engine_tpu.utils.stats import g_stats
+    with open(s._statsdb_path, "a", encoding="utf-8") as fh:
+        fh.write(json.dumps([1e9, {"qps": 5.0}]) + "\n")
+    s.stop()
+    s2 = SearchHTTPServer(tmp_path, port=0)
+    s2.start()
+    try:
+        assert any(m.get("qps") == 5.0 for _, m in g_stats.timeseries)
+    finally:
+        s2.stop()
+
+
+def test_gbconf_loads_master_password(tmp_path):
+    c = Conf()
+    c.master_password = "fromfile"
+    c.save(tmp_path / "gb.conf")
+    s = SearchHTTPServer(tmp_path, port=0)
+    assert s.conf.master_password == "fromfile"

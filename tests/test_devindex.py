@@ -37,6 +37,7 @@ DOCS = {
 @pytest.fixture(scope="module")
 def coll(tmp_path_factory):
     c = Collection("dev", tmp_path_factory.mktemp("dev"))
+    c.conf.pqr_enabled = False  # kernel-parity tests pin pre-PQR scores
     for u, h in DOCS.items():
         docproc.index_document(c, u, h)
     return c
@@ -99,6 +100,7 @@ class TestResidentParity:
 
     def test_empty_collection(self, tmp_path):
         c = Collection("empty", tmp_path)
+        c.conf.pqr_enabled = False  # kernel-parity tests pin pre-PQR scores
         assert search_device(c, "anything").total_matches == 0
 
     def test_pure_negative_query_matches_host(self, coll):
@@ -116,6 +118,7 @@ class TestResidentParity:
         scatter lanes are routed to the drop row (duplicate-index
         scatter order is implementation-defined on TPU)."""
         c = Collection("quota", tmp_path)
+        c.conf.pqr_enabled = False  # kernel-parity tests pin pre-PQR scores
         spam = " ".join(["pepper"] * 24) + " pepper mill grinder."
         docproc.index_document(
             c, "http://q.example.com/mill",
@@ -145,6 +148,8 @@ class TestScale:
         from open_source_search_engine_tpu.utils import ghash
 
         c = Collection("big", tmp_path)
+
+        c.conf.pqr_enabled = False  # kernel-parity tests pin pre-PQR scores
         n = 40_000  # > the old 32768-per-run resident cap
         docids = np.arange(1, n + 1, dtype=np.uint64)
         common = ghash.term_id("common")
@@ -164,9 +169,11 @@ class TestScale:
                             with_snippets=False, site_cluster=False)
         assert host.total_matches == len(docids[::200])
         assert dev.total_matches == host.total_matches
-        key = lambda r: (-round(r.score, 3), r.docid)
-        assert sorted(map(key, dev.results)) == \
-               sorted(map(key, host.results))
+        # identical postings per doc → massive score ties: the two
+        # paths may legitimately return different tie members, so pin
+        # the score sequence (the tie-aware parity contract)
+        assert [round(r.score, 3) for r in dev.results] == \
+               [round(r.score, 3) for r in host.results]
 
         # single common term: every doc matches, none truncated away.
         # Scores tie massively (identical postings), so the two paths
@@ -190,6 +197,7 @@ class TestIncrementalDelta:
 
     def test_adds_and_deletes_without_full_rebuild(self, tmp_path):
         c = Collection("inc", tmp_path)
+        c.conf.pqr_enabled = False  # kernel-parity tests pin pre-PQR scores
         for i in range(30):
             docproc.index_document(
                 c, f"http://inc.test/d{i}",
@@ -244,6 +252,7 @@ class TestIncrementalDelta:
         no tombstone survives — the base copy must still be superseded
         or the doc serves from both base and delta with doubled df."""
         c = Collection("recrawl", tmp_path)
+        c.conf.pqr_enabled = False  # kernel-parity tests pin pre-PQR scores
         html = ("<html><head><title>Evergreen</title></head><body>"
                 "<p>evergreen content never changes.</p></body></html>")
         docproc.index_document(c, "http://re.test/page", html)
@@ -277,6 +286,7 @@ class TestFullCubePath:
         monkeypatch.setattr(dv, "DENSE_MIN_DF", 0)
         monkeypatch.setattr(dv, "CUBE_MIN_DF", 16)
         c = Collection("f2", tmp_path)
+        c.conf.pqr_enabled = False  # kernel-parity tests pin pre-PQR scores
         for i in range(200):
             extra = "orange grove" if i % 3 == 0 else "plain field"
             docproc.index_document(
@@ -327,6 +337,7 @@ class TestClusterdbRead:
 
     def test_hidden_results_skip_titledb(self, tmp_path):
         c = Collection("clu", tmp_path)
+        c.conf.pqr_enabled = False  # kernel-parity tests pin pre-PQR scores
         for i in range(6):
             docproc.index_document(
                 c, f"http://one.site.test/p{i}",

@@ -30,6 +30,9 @@ EXPECTED = json.loads(
 @pytest.fixture(scope="module")
 def coll(tmp_path_factory):
     c = Collection("golden", tmp_path_factory.mktemp("golden"))
+    # goldens pin the KERNEL ranking; the PostQueryRerank pass is a
+    # deliberate post-filter with its own tests (test_rerank)
+    c.conf.pqr_enabled = False
     for url, html in golden_docs().items():
         docproc.index_document(c, url, html)
     return c
@@ -41,6 +44,9 @@ def sharded(tmp_path_factory):
         ShardedCollection, make_mesh)
     sc = ShardedCollection("goldens", tmp_path_factory.mktemp("goldens"),
                            n_shards=4)
+    for row in sc.grid:
+        for c in row:
+            c.conf.pqr_enabled = False
     for url, html in golden_docs().items():
         sc.index_document(url, html)
     return sc, make_mesh(4)

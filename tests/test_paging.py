@@ -1,0 +1,102 @@
+"""Deep paging (reference ``s=`` start row; TopTree top-X, TopTree.h:15).
+
+Pages must be stable and disjoint: page k at size n equals rows
+[k·n, (k+1)·n) of one big fetch, with dedup/site-clustering applied
+BEFORE pagination so page boundaries don't shift between requests.
+Covers the flat engine, the resident device path, the sharded mesh
+path, and the HTTP ``s=`` parameter.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from open_source_search_engine_tpu.build import docproc
+from open_source_search_engine_tpu.index.collection import Collection
+from open_source_search_engine_tpu.query import engine
+
+
+def _corpus(target, n=30):
+    from open_source_search_engine_tpu.parallel.sharded import \
+        ShardedCollection
+    for i in range(n):
+        html = (f"<html><title>page {i}</title><body>"
+                f"<p>paging corpus shared words item{i} "
+                f"{'extra ' * (n - i)}depth</p></body></html>")
+        url = f"http://site{i % 11}.test/p{i}"
+        if isinstance(target, ShardedCollection):
+            target.index_document(url, html)
+        else:
+            docproc.index_document(target, url, html)
+
+
+@pytest.fixture(scope="module")
+def coll(tmp_path_factory):
+    c = Collection("pg", tmp_path_factory.mktemp("paging"))
+    _corpus(c)
+    return c
+
+
+def _urls(res):
+    return [r.url for r in res.results]
+
+
+def test_flat_pages_partition_the_full_list(coll):
+    full = engine.search(coll, "shared words", topk=30)
+    pages = [engine.search(coll, "shared words", topk=7, offset=off)
+             for off in range(0, 28, 7)]
+    got = [u for p in pages for u in _urls(p)]
+    assert got == _urls(full)[: len(got)]
+    assert len(set(got)) == len(got)  # disjoint
+
+
+def test_flat_offset_past_end_is_empty(coll):
+    assert _urls(engine.search(coll, "shared words", topk=10,
+                               offset=10000)) == []
+
+
+def test_device_pages_match_flat(coll):
+    full = engine.search_device(coll, "shared words", topk=30,
+                                with_snippets=False)
+    p2 = engine.search_device(coll, "shared words", topk=5, offset=5,
+                              with_snippets=False)
+    assert _urls(p2) == _urls(full)[5:10]
+
+
+def test_sharded_pages_partition(tmp_path):
+    from open_source_search_engine_tpu.parallel import sharded_search
+    from open_source_search_engine_tpu.parallel.sharded import \
+        ShardedCollection
+    sc = ShardedCollection("pg", tmp_path, n_shards=4)
+    _corpus(sc)
+    full = sharded_search(sc, "shared words", topk=30)
+    pages = [sharded_search(sc, "shared words", topk=6, offset=off)
+             for off in range(0, 24, 6)]
+    got = [u for p in pages for u in _urls(p)]
+    assert got == _urls(full)[: len(got)]
+
+
+def test_http_s_param(tmp_path):
+    from open_source_search_engine_tpu.serve.server import SearchHTTPServer
+    srv = SearchHTTPServer(tmp_path, port=0)
+    _corpus(srv.colldb.get("main"), n=12)
+    srv.start()
+    try:
+        port = srv._httpd.server_port
+
+        def q(s):
+            return json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/search?q=shared+words"
+                f"&n=4&s={s}&format=json").read())
+        p0, p1 = q(0), q(4)
+        u0 = [h["url"] for h in p0["results"]]
+        u1 = [h["url"] for h in p1["results"]]
+        assert len(u0) == 4 and len(u1) == 4
+        assert not set(u0) & set(u1)
+        full = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/search?q=shared+words"
+            f"&n=8&format=json").read())
+        assert [h["url"] for h in full["results"]] == u0 + u1
+    finally:
+        srv.stop()

@@ -34,6 +34,9 @@ def mesh():
 def sc(tmp_path_factory, mesh):
     s = ShardedCollection("ptest", tmp_path_factory.mktemp("ptest"),
                           n_shards=4)
+    for _row in s.grid:
+        for _c in _row:
+            _c.conf.pqr_enabled = False
     for url, html in DOCS.items():
         s.index_document(url, html)
     return s
@@ -43,6 +46,7 @@ def sc(tmp_path_factory, mesh):
 def flat(tmp_path_factory):
     """Same corpus in one unsharded collection — ranking ground truth."""
     c = Collection("flat", tmp_path_factory.mktemp("flat"))
+    c.conf.pqr_enabled = False  # kernel-parity tests pin pre-PQR scores
     for url, html in DOCS.items():
         docproc.index_document(c, url, html)
     return c
@@ -127,6 +131,9 @@ class TestShardedSearch:
             "<html><body><p>common rareterm together in one doc</p>"
             "</body></html>")
         sc2 = ShardedCollection("fw", tmp_path / "fw", n_shards=4)
+        for _row in sc2.grid:
+            for _c in _row:
+                _c.conf.pqr_enabled = False
         flat2 = Collection("fwflat", tmp_path / "fwflat")
         for u, h in docs.items():
             sc2.index_document(u, h)
@@ -159,6 +166,9 @@ class TestReplicas:
     def rsc(self, tmp_path, mesh):
         s = ShardedCollection("rtest", tmp_path / "rtest",
                               n_shards=4, n_replicas=2)
+        for _row in s.grid:
+            for _c in _row:
+                _c.conf.pqr_enabled = False
         for url, html in DOCS.items():
             s.index_document(url, html)
         return s

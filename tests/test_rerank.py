@@ -1,0 +1,133 @@
+"""PostQueryRerank + AutoBan + least-loaded replica reads.
+
+Reference: ``PostQueryRerank.cpp`` demotion factors over the merged
+top window; ``AutoBan.cpp`` per-IP query rate bans; Multicast's
+prefer-less-loaded twin for reads.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from open_source_search_engine_tpu.build import docproc
+from open_source_search_engine_tpu.index.collection import Collection
+from open_source_search_engine_tpu.query import engine
+from open_source_search_engine_tpu.query.engine import Result
+from open_source_search_engine_tpu.query.rerank import post_query_rerank
+
+
+def _r(docid, score, url):
+    return Result(docid=docid, score=score, url=url)
+
+
+def test_same_domain_results_demote_geometrically():
+    rs = [_r(1, 100.0, "http://a.test/1"),
+          _r(2, 99.0, "http://a.test/2"),
+          _r(3, 98.0, "http://b.test/1"),
+          _r(4, 97.0, "http://a.test/3")]
+    post_query_rerank(rs, site_demote=0.5, depth_demote=1.0)
+    by_id = {r.docid: r.score for r in rs}
+    assert by_id[1] == 100.0          # first of its domain: untouched
+    assert by_id[2] == pytest.approx(99.0 * 0.5)    # 2nd a.test
+    assert by_id[3] == 98.0
+    assert by_id[4] == pytest.approx(97.0 * 0.25)   # 3rd a.test
+    assert [r.docid for r in rs] == [1, 3, 2, 4]    # re-sorted
+
+
+def test_depth_demotion_prefers_canonical_pages():
+    rs = [_r(1, 100.0, "http://a.test/x/y/z/deep.html"),
+          _r(2, 100.0, "http://b.test/")]
+    post_query_rerank(rs, site_demote=1.0, depth_demote=0.9)
+    assert rs[0].docid == 2  # the root page wins the tie
+
+
+def test_language_demotion_uses_lookup():
+    rs = [_r(1, 100.0, "http://a.test/"), _r(2, 99.0, "http://b.test/")]
+    post_query_rerank(rs, qlang=1, lang_demote=0.5, site_demote=1.0,
+                      depth_demote=1.0,
+                      langid_of=lambda d: 2 if d == 1 else 1)
+    assert rs[0].docid == 2 and rs[1].score == pytest.approx(50.0)
+
+
+def test_pqr_window_keeps_pages_consistent(tmp_path):
+    """Pages still partition the full list with PQR on: the rerank
+    window is fixed by rank, not by the requested page."""
+    coll = Collection("pqr", tmp_path)
+    for i in range(20):
+        docproc.index_document(
+            coll, f"http://s{i % 5}.test/a/b{i % 3}/p{i}",
+            f"<html><title>t{i}</title><body><p>pqr shared words "
+            f"uniq{i}</p></body></html>")
+    full = engine.search(coll, "pqr shared", topk=20,
+                         with_snippets=False)
+    pages = [engine.search(coll, "pqr shared", topk=5, offset=off,
+                           with_snippets=False)
+             for off in (0, 5, 10)]
+    got = [r.url for p in pages for r in p.results]
+    assert got == [r.url for r in full.results][: len(got)]
+
+
+def test_pqr_disabled_by_parm(tmp_path):
+    coll = Collection("pqr2", tmp_path)
+    coll.conf.pqr_enabled = False
+    for i in range(4):
+        docproc.index_document(
+            coll, f"http://one.test/deep/path/p{i}",
+            f"<html><title>t</title><body><p>parm words u{i}</p>"
+            "</body></html>")
+    res = engine.search(coll, "parm words", topk=4,
+                        with_snippets=False, site_cluster=False)
+    # all same domain + deep paths: with PQR off, raw kernel order and
+    # no demotion-induced score changes (scores strictly nonincreasing)
+    scores = [r.score for r in res.results]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_autoban_429(tmp_path):
+    from open_source_search_engine_tpu.serve.server import \
+        SearchHTTPServer
+    srv = SearchHTTPServer(tmp_path, port=0)
+    coll = srv.colldb.get("main")
+    coll.conf.autoban_qps = 3
+    docproc.index_document(coll, "http://x.test/",
+                           "<html><body>ban corpus words</body></html>")
+    # trip the limiter directly (no query latency in the loop: the
+    # window math must not depend on how long searches take)
+    verdicts = [srv._autobanned("9.9.9.9", 3) for _ in range(8)]
+    assert verdicts[0] is False and verdicts[-1] is True
+    # a banned client's /search is refused BEFORE any query work
+    assert srv.handle("GET", "/search", {"q": "ban corpus"}, b"",
+                      client_ip="9.9.9.9")[0] == 429
+    # a different client is unaffected
+    assert srv.handle("GET", "/search", {"q": "ban corpus"}, b"",
+                      client_ip="8.8.8.8")[0] == 200
+    # other pages unaffected even for the banned ip
+    assert srv.handle("GET", "/admin/stats", {}, b"",
+                      client_ip="9.9.9.9")[0] == 200
+
+
+def test_read_ewma_prefers_faster_twin():
+    from open_source_search_engine_tpu.parallel.cluster import (
+        ClusterClient, HostsConf)
+    conf = HostsConf(n_shards=1, n_replicas=2,
+                     addresses=[["127.0.0.1:1", "127.0.0.1:2"]])
+    cc = ClusterClient(conf, use_heartbeat=False)
+    try:
+        cc._read_ewma[0][0] = 0.5   # slow twin
+        cc._read_ewma[0][1] = 0.01  # fast twin
+        order = sorted(
+            range(2),
+            key=lambda r: (not cc.hostmap.alive[0, r],
+                           cc._read_ewma[0][r]))
+        assert order == [1, 0]
+        cc.hostmap.mark_dead(0, 1)  # liveness dominates latency
+        order = sorted(
+            range(2),
+            key=lambda r: (not cc.hostmap.alive[0, r],
+                           cc._read_ewma[0][r]))
+        assert order == [0, 1]
+    finally:
+        cc.close()

@@ -1,0 +1,115 @@
+"""Sections-lite: tag-path section ids, sectiondb votes, boilerplate
+demotion (reference Sections.cpp/h:330 — section tree + cross-page dup
+votes demoting repeated chrome at scoring time).
+"""
+
+import numpy as np
+import pytest
+
+from open_source_search_engine_tpu.build import docproc
+from open_source_search_engine_tpu.build.tokenizer import tokenize_html
+from open_source_search_engine_tpu.index import posdb
+from open_source_search_engine_tpu.index.collection import Collection
+from open_source_search_engine_tpu.index.sectiondb import Sectiondb
+from open_source_search_engine_tpu.query import engine
+
+NAV = ('<nav><ul><li><a href="/x">zebra products catalog</a></li>'
+       "<li>zebra support pages</li></ul></nav>")
+
+
+def _page(i, body):
+    return (f"<html><head><title>Page {i}</title></head><body>{NAV}"
+            f"<div><p>{body}</p></div></body></html>")
+
+
+def test_section_ids_stable_across_pages():
+    t1 = tokenize_html(_page(1, "alpha beta gamma"), "http://s.test/1")
+    t2 = tokenize_html(_page(2, "delta epsilon zeta"), "http://s.test/2")
+    s1 = docproc.doc_section_hashes(t1)
+    s2 = docproc.doc_section_hashes(t2)
+    # the identical nav produces an identical (section id, content
+    # hash) on both pages; the differing body paragraphs do not
+    shared = set(s1.items()) & set(s2.items())
+    assert shared, "identical nav must hash identically"
+    assert set(s1.values()) != set(s2.values())
+
+
+def test_sectiondb_votes_and_removal(tmp_path):
+    db = Sectiondb(tmp_path)
+    for i in range(3):
+        db.add_page_sections("s.test", f"http://s.test/{i}", [0xABC])
+    assert db.page_count("s.test", 0xABC) == 3
+    assert db.boiler_set("s.test", [0xABC, 0xDEF]) == {0xABC}
+    db.remove_page_sections("s.test", "http://s.test/0", [0xABC])
+    db.remove_page_sections("s.test", "http://s.test/1", [0xABC])
+    assert db.page_count("s.test", 0xABC) == 1
+    assert db.boiler_set("s.test", [0xABC]) == set()
+
+
+def test_boilerplate_demotes_nav_tokens(tmp_path):
+    """After enough sibling pages, nav words get docked spam ranks
+    while body words keep 15 — and ranking prefers a body hit."""
+    from open_source_search_engine_tpu.index.sectiondb import \
+        BOILER_SPAMRANK
+    coll = Collection("sec", tmp_path)
+    coll.conf.pqr_enabled = False
+    for i in range(4):
+        docproc.index_document(coll, f"http://s.test/chrome{i}",
+                               _page(i, f"filler body words number{i}"))
+    # a later page whose BODY mentions zebra (nav is boilerplate now)
+    ml_body = docproc.index_document(
+        coll, "http://s.test/body",
+        _page(9, "the zebra animal gallops across plains"))
+    f = posdb.unpack(ml_body.posdb_keys)
+    zebra_tid = np.uint64(__import__(
+        "open_source_search_engine_tpu.utils.ghash",
+        fromlist=["x"]).term_id("zebra"))
+    z = f["termid"] == zebra_tid
+    spam = f["wordspamrank"][z]
+    # body occurrence clean (15), nav occurrences docked
+    assert spam.max() == 15
+    assert spam.min() == BOILER_SPAMRANK
+    assert ml_body.boiler_sections  # recorded in the meta list
+    # ranking: the body page outscores a chrome-only page for "zebra"
+    r = engine.search(coll, "zebra", topk=10, site_cluster=False,
+                      with_snippets=False)
+    assert r.results[0].url == "http://s.test/body"
+
+
+def test_tombstones_regenerate_docked_postings(tmp_path):
+    """Removal after MORE votes accumulated must still annihilate —
+    the boiler set is frozen in the TitleRec at add time."""
+    coll = Collection("sec2", tmp_path)
+    for i in range(3):
+        docproc.index_document(coll, f"http://s.test/p{i}",
+                               _page(i, f"unique body {i}"))
+    # p2 was indexed when nav was already boilerplate (2 prior pages)
+    docproc.index_document(coll, "http://s.test/late",
+                           _page(7, "late page body words"))
+    # more pages pile on votes AFTER "late" was indexed
+    for i in range(3, 6):
+        docproc.index_document(coll, f"http://s.test/p{i}",
+                               _page(i, f"unique body {i}"))
+    assert docproc.remove_document(coll, "http://s.test/late")
+    r = engine.search(coll, "late page", topk=5, with_snippets=False)
+    assert all(res.url != "http://s.test/late" for res in r.results)
+    # the annihilation was exact: no orphan postings for its unique word
+    r2 = engine.search(coll, "late", topk=5, with_snippets=False)
+    assert r2.total_matches == 0
+
+
+def test_sharded_sections_route_by_site(tmp_path):
+    from open_source_search_engine_tpu.parallel.sharded import \
+        ShardedCollection
+    sc = ShardedCollection("sec3", tmp_path, n_shards=2)
+    for i in range(4):
+        sc.index_document(f"http://s.test/n{i}",
+                          _page(i, f"sharded body {i}"))
+    ml = sc.index_document("http://s.test/check",
+                           _page(9, "checking boiler state here"))
+    assert ml.boiler_sections
+    sect_shard = int(sc.hostmap.shard_of_site("s.test"))
+    assert sc.shards[sect_shard].sectiondb.page_count(
+        "s.test", ml.boiler_sections[0]) >= 4
+    other = sc.shards[1 - sect_shard].sectiondb
+    assert other.page_count("s.test", ml.boiler_sections[0]) == 0

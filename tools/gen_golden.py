@@ -27,6 +27,7 @@ from tests.golden.corpus import GOLDEN_QUERIES, golden_docs  # noqa: E402
 
 def main() -> None:
     coll = Collection("golden", tempfile.mkdtemp(prefix="osse_golden_"))
+    coll.conf.pqr_enabled = False  # goldens pin the kernel ranking
     for url, html in golden_docs().items():
         docproc.index_document(coll, url, html)
     out = {}
