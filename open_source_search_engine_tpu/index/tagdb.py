@@ -139,6 +139,12 @@ class Tagdb:
         segs = [s for s in u.path.split("/") if s]
         if segs and not u.path.endswith("/"):
             segs = segs[:-1]  # directories only, never the filename
+        # the exact normalized input always probes first: site strings
+        # deeper than the probe cap (site_of can produce them whenever
+        # sitepathdepth exceeds it) must round-trip through set_tag/
+        # get_tag
+        if len(segs) > 3:
+            cands.append(u.host + "/" + "/".join(segs) + "/")
         for depth in range(min(len(segs), 3), 0, -1):
             cands.append(u.host + "/" + "/".join(segs[:depth]) + "/")
         cands.append(u.host)

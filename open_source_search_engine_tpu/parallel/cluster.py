@@ -139,6 +139,9 @@ class ShardNodeServer:
         self._journal = open(self._journal_path, "a",  # noqa: SIM115
                              encoding="utf-8")
         self._writes_since_save = 0
+        #: writes accepted while a heal pull is in flight (replayed on
+        #: top of the pulled snapshot — see heal_from)
+        self._heal_buffer: list[dict] | None = None
 
     def _replay_journal(self) -> None:
         from ..build import docproc
@@ -187,6 +190,10 @@ class ShardNodeServer:
             if path == "/rpc/index":
                 self._journal_write({"url": payload["url"],
                                      "content": payload["content"]})
+                if self._heal_buffer is not None:
+                    self._heal_buffer.append(
+                        {"url": payload["url"],
+                         "content": payload["content"]})
                 ml = docproc.index_document(
                     self.coll, payload["url"], payload["content"])
                 self._maybe_checkpoint()
@@ -198,6 +205,9 @@ class ShardNodeServer:
             if path == "/rpc/remove":
                 self._journal_write({"op": "remove",
                                      "url": payload["url"]})
+                if self._heal_buffer is not None:
+                    self._heal_buffer.append({"op": "remove",
+                                              "url": payload["url"]})
                 ok = docproc.remove_document(self.coll, payload["url"])
                 return {"ok": bool(ok)}
             if path == "/rpc/search":
@@ -233,6 +243,18 @@ class ShardNodeServer:
                     return {"ok": False, "error": f"no rdb {name}"}
                 return {"ok": True, "batch": _encode_batch(rdb.get_all()),
                         "num_docs": self.coll.num_docs}
+            if path == "/rpc/pull-all":
+                # single CONSISTENT cut: every Rdb + speller + num_docs
+                # snapshotted under the writer lock — a healing sibling
+                # must never mix Rdb generations (titledb holding a doc
+                # whose posdb postings are missing)
+                return {
+                    "ok": True,
+                    "rdbs": {name: _encode_batch(rdb.get_all())
+                             for name, rdb in self.coll.rdbs().items()},
+                    "counts": dict(self.coll.speller.counts),
+                    "num_docs": self.coll.num_docs,
+                }
         raise KeyError(path)
 
     def scrub(self) -> list[str]:
@@ -248,39 +270,71 @@ class ShardNodeServer:
         sibling's content (also the recovered-twin catch-up — a node
         that was dead while writes flowed rejoins consistent).
 
-        ALL pulls complete before anything local is touched: a sibling
-        dying mid-heal must not leave this node with mixed Rdb
-        generations (posdb from the twin, titledb from before)."""
-        pulled: dict[str, dict] = {}
+        Consistency, both directions: the SOURCE snapshots all Rdbs in
+        ONE /rpc/pull-all held under its writer lock (a single cut —
+        never titledb from one generation and posdb from another), and
+        the RECEIVER keeps accepting writes during the multi-second
+        pull, buffering them and replaying them on top of the applied
+        snapshot — so nothing delivered in the pull window is lost."""
+        from ..build import docproc
+
+        with self._lock:
+            if self._heal_buffer is not None:
+                log.warning("heal from %s refused: heal already in "
+                            "progress", addr)
+                return 0
+            self._heal_buffer = []
         try:
-            for name in self.coll.rdbs():
-                out = _rpc(addr, "/rpc/pull", {"name": name},
-                           timeout=120.0)
-                if not out.get("ok"):
-                    raise RuntimeError(
-                        f"pull {name}: {out.get('error', 'not ok')}")
-                pulled[name] = out
-            sp = _rpc(addr, "/rpc/pull", {"name": "speller"},
-                      timeout=120.0)
+            out = _rpc(addr, "/rpc/pull-all", {}, timeout=300.0)
+            if not out.get("ok"):
+                raise RuntimeError(out.get("error", "pull-all not ok"))
+            pulled = out["rdbs"]
+            missing = [n for n in self.coll.rdbs() if n not in pulled]
+            if missing:
+                # apply nothing: a partial snapshot would leave mixed
+                # Rdb generations — the exact state heal exists to fix
+                raise RuntimeError(f"snapshot missing rdbs {missing}")
         except Exception as e:  # noqa: BLE001 — transport/sibling death
+            with self._lock:
+                self._heal_buffer = None
             log.error("heal from %s aborted before applying: %s",
                       addr, e)
             return 0
         with self._lock:
-            num_docs = self.coll.num_docs
-            for name, rdb in self.coll.rdbs().items():
-                rdb.replace_with(_decode_batch(pulled[name]["batch"]))
-                num_docs = pulled[name].get("num_docs", num_docs)
-            self.coll.num_docs = num_docs
-            if sp.get("ok"):
-                from collections import defaultdict
-                self.coll.speller.counts = defaultdict(
-                    int, sp["counts"])
-                self.coll.speller._len_index = None
-            self.coll.titlerec_cache.clear()
-            self.coll._save_stats()
-            log.info("healed %d rdbs from %s", len(pulled), addr)
-            return len(pulled)
+            try:
+                for name, rdb in self.coll.rdbs().items():
+                    rdb.replace_with(_decode_batch(pulled[name]))
+                self.coll.num_docs = out.get("num_docs",
+                                             self.coll.num_docs)
+                if "counts" in out:
+                    from collections import defaultdict
+                    self.coll.speller.counts = defaultdict(
+                        int, out["counts"])
+                    self.coll.speller._len_index = None
+                self.coll.titlerec_cache.clear()
+                # replay the pull-window writes on the fresh snapshot
+                # (they were applied to the OLD state, which
+                # replace_with just discarded; the journal still holds
+                # them for crash safety)
+                buf = self._heal_buffer or []
+                for rec in buf:
+                    try:
+                        if rec.get("op") == "remove":
+                            docproc.remove_document(self.coll,
+                                                    rec["url"])
+                        else:
+                            docproc.index_document(
+                                self.coll, rec["url"], rec["content"])
+                    except Exception as e:  # noqa: BLE001
+                        log.warning("heal replay skipped a record: %s",
+                                    e)
+                self.coll._save_stats()
+                log.info("healed %d rdbs from %s (+%d pull-window "
+                         "writes replayed)", len(pulled), addr,
+                         len(buf))
+                return len(pulled)
+            finally:
+                self._heal_buffer = None
 
     def save(self) -> None:
         """Checkpoint under the writer lock; the saved state supersedes

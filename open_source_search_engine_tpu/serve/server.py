@@ -33,6 +33,7 @@ from ..index.collection import CollectionDb
 from ..query import engine
 from ..query.summary import highlight
 from ..utils.log import get_logger
+from ..utils import parms as parms_mod
 from ..utils.parms import Conf
 
 log = get_logger("http")
@@ -263,10 +264,23 @@ class SearchHTTPServer:
             if path == "/":
                 return 200, self._page_root(), "text/html"
             if path == "/search":
-                limit = int(self._coll(query).conf.autoban_qps)
+                # autoban runs BEFORE any collection lookup, and read
+                # paths never create collections — unauthenticated
+                # requests with arbitrary c= names must not mint
+                # directory trees on disk (nor bypass the rate limit)
+                coll = self._coll_read(query)
+                # unknown-collection requests still get the COLL-scope
+                # default limit — 404ing must not bypass the rate gate
+                limit = int(coll.conf.autoban_qps) if coll is not None \
+                    else int(parms_mod.parm("autoban_qps").default)
                 if self._autobanned(client_ip, limit):
                     return 429, json.dumps(
                         {"error": "query rate limit (autoban)"}), \
+                        "application/json"
+                if coll is None and self.sharded is None \
+                        and self.cluster is None:
+                    return 404, json.dumps(
+                        {"error": "no such collection"}), \
                         "application/json"
                 # NOT under the global lock: the micro-batcher would
                 # deadlock (its worker takes the lock), and holding it
@@ -283,9 +297,16 @@ class SearchHTTPServer:
                body: bytes) -> tuple[int, str, str]:
         if path == "/get":
             return self._page_get(query)
-        if path == "/inject":
-            return self._page_inject(query, body)
-        if path == "/addurl":
+        if path in ("/inject", "/addurl"):
+            # index-mutating endpoints are admin-gated once a master
+            # password is set (the reference gates injection behind the
+            # admin password, PageInject/Pages auth)
+            if not self._authorized(query):
+                self.stats["auth_denied"] += 1
+                return 401, json.dumps(
+                    {"error": "bad or missing pwd"}), "application/json"
+            if path == "/inject":
+                return self._page_inject(query, body)
             return self._page_addurl(query)
         if path.startswith("/admin") and not self._authorized(query):
             self.stats["auth_denied"] += 1
@@ -328,6 +349,18 @@ class SearchHTTPServer:
     def _coll(self, query: dict):
         return self.colldb.get(query.get("c", "main"))
 
+    def _coll_read(self, query: dict):
+        """Read-path collection lookup: NEVER creates on-disk state for
+        arbitrary ``c=`` names — except the default collection, which
+        stays lazily creatable (a fresh instance must answer
+        ``/search?q=x`` with zero results, not 404). Returns None for
+        unknown collections."""
+        name = query.get("c", "main")
+        try:
+            return self.colldb.get(name, create=(name == "main"))
+        except KeyError:
+            return None
+
     def _page_root(self) -> str:
         return ('<html><body><form action="/search">'
                 '<input name="q"><input type="submit" value="search">'
@@ -345,8 +378,11 @@ class SearchHTTPServer:
         fmt = query.get("format", "json")
         self.stats["queries"] += 1
         if self.cluster is not None:
+            # conf is only consulted for PQR factors — never create a
+            # local collection just to read it
+            c = self._coll_read(query)
             res = self.cluster.search(q, topk=n, offset=s,
-                                      conf=self._coll(query).conf)
+                                      conf=c.conf if c else None)
         elif self.sharded is not None:
             from ..parallel import sharded_search
             with self._lock:
@@ -380,7 +416,9 @@ class SearchHTTPServer:
         elif self.sharded is not None:
             rec = self.sharded.get_document(docid)
         else:
-            rec = docproc.get_document(self._coll(query), docid=docid)
+            coll = self._coll_read(query)  # read path: never mint colls
+            rec = docproc.get_document(coll, docid=docid) \
+                if coll is not None else None
         if rec is None:
             return 404, json.dumps({"error": "not found"}), \
                 "application/json"

@@ -166,3 +166,11 @@ class CollectionConf(_ParmObject):
 def parm_table() -> list[Parm]:
     """The full table — used by the admin UI to render parameter pages."""
     return list(PARMS)
+
+
+def parm(name: str) -> Parm:
+    """One parm's table entry by name (any scope)."""
+    for scope in _BY_SCOPE.values():
+        if name in scope:
+            return scope[name]
+    raise KeyError(f"unknown parm {name!r}")

@@ -117,3 +117,29 @@ def test_gbconf_loads_master_password(tmp_path):
     c.save(tmp_path / "gb.conf")
     s = SearchHTTPServer(tmp_path, port=0)
     assert s.conf.master_password == "fromfile"
+
+
+def test_inject_and_addurl_require_password_when_set(srv):
+    srv.conf.master_password = "sekrit"
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(srv, "/inject?u=http://x.test/p")
+    assert e.value.code == 401
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(srv, "/addurl?u=http://x.test/p")
+    assert e.value.code == 401
+    # with the password they pass auth (addurl then 503s: no spider)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(srv, "/addurl?u=http://x.test/p&pwd=sekrit")
+    assert e.value.code == 503
+    r = _get(srv, "/inject?u=http://x.test/p&pwd=sekrit&content=hi")
+    assert r.status == 200
+    srv.conf.master_password = ""
+
+
+def test_search_never_creates_collections(srv, tmp_path):
+    """Unauthenticated /search with an arbitrary c= name must not mint
+    collection directories on disk (404s instead)."""
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(srv, "/search?q=words&c=doesnotexist")
+    assert e.value.code == 404
+    assert not (srv.colldb.base_dir / "coll" / "doesnotexist").exists()

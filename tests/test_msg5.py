@@ -185,3 +185,43 @@ def test_cluster_heal_from_twin(tmp_path):
         assert b.coll.speller.counts == a.coll.speller.counts
     finally:
         a.stop()
+
+
+def test_heal_single_cut_replays_pull_window_writes(tmp_path,
+                                                    monkeypatch):
+    """Writes delivered to a healing node DURING the pull window must
+    survive the snapshot apply (the heal buffers and replays them); the
+    snapshot itself arrives as one consistent cut (/rpc/pull-all)."""
+    from open_source_search_engine_tpu.parallel import cluster as cl
+
+    a = cl.ShardNodeServer(tmp_path / "a")
+    b = cl.ShardNodeServer(tmp_path / "b")
+    _index_corpus(a.coll)
+    a.coll.dump_all()
+    a.start()
+    real_rpc = cl._rpc
+
+    def rpc_with_concurrent_write(addr, path, payload, timeout=10.0):
+        # deliver a write to the HEALING node mid-pull: it lands after
+        # the buffer is armed and before the snapshot applies
+        b.handle("/rpc/index", {
+            "url": "http://late.test/during-heal",
+            "content": "<html><body>window write survives</body></html>",
+        })
+        return real_rpc(addr, path, payload, timeout)
+
+    monkeypatch.setattr(cl, "_rpc", rpc_with_concurrent_write)
+    try:
+        n = b.heal_from(f"127.0.0.1:{a.port}")
+        assert n == len(b.coll.rdbs())
+        # the pulled corpus is there...
+        d = docproc.get_document(b.coll, url="http://site0.test/p0")
+        assert d and "healing corpus" in d["text"]
+        # ...and so is the write that raced the pull
+        d2 = docproc.get_document(b.coll,
+                                  url="http://late.test/during-heal")
+        assert d2 and "window write" in d2["text"]
+        assert b.coll.num_docs == a.coll.num_docs + 1
+        assert b._heal_buffer is None  # disarmed after apply
+    finally:
+        a.stop()

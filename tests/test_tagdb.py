@@ -188,3 +188,19 @@ def test_shard_of_tagdb_keys_is_sitehash_stable(tmp_path):
     k1 = pack_key("example.com", "a")
     k2 = pack_key("example.com", "b")
     assert int(k1["n1"]) == int(k2["n1"]) == ghash.hash64("example.com")
+
+
+def test_deep_site_tag_roundtrip(tmp_path):
+    """A tag set on a site string deeper than the probe cap (which
+    site_of can itself produce when sitepathdepth >= 4) must round-trip
+    through get_tag/is_banned — the exact normalized string probes
+    first."""
+    from open_source_search_engine_tpu.index.tagdb import (TAG_MANUAL_BAN,
+                                                           Tagdb)
+    t = Tagdb(tmp_path)
+    deep = "host.test/a/b/c/d/"
+    t.set_tag(deep, TAG_MANUAL_BAN, True)
+    assert t.get_tag(deep, TAG_MANUAL_BAN) is True
+    assert t.is_banned(deep)
+    assert t.is_banned("http://host.test/a/b/c/d/page.html")
+    assert not t.is_banned("http://host.test/a/b/c/other.html")
