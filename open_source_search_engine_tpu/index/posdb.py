@@ -111,15 +111,21 @@ def pack(
     returns a structured array of :data:`KEY_DTYPE`."""
     termid = _u64(termid) & np.uint64(TERMID_MASK)
     docid = _u64(docid) & np.uint64(DOCID_MASK)
-    args = [
-        termid, docid, _u64(wordpos), _u64(densityrank), _u64(diversityrank),
-        _u64(wordspamrank), _u64(siterank), _u64(hashgroup), _u64(langid),
-        _u64(multiplier), _u64(synform), _u64(outlink), _u64(shardbytermid),
-        _u64(delbit),
-    ]
-    (termid, docid, wordpos, densityrank, diversityrank, wordspamrank,
-     siterank, hashgroup, langid, multiplier, synform, outlink,
-     shardbytermid, delbit) = np.broadcast_arrays(*args)
+    wordpos, densityrank, diversityrank, wordspamrank = (
+        _u64(wordpos), _u64(densityrank), _u64(diversityrank),
+        _u64(wordspamrank))
+    siterank, hashgroup, langid, multiplier = (
+        _u64(siterank), _u64(hashgroup), _u64(langid), _u64(multiplier))
+    synform, outlink, shardbytermid, delbit = (
+        _u64(synform), _u64(outlink), _u64(shardbytermid), _u64(delbit))
+    # no broadcast_arrays: the bit expressions broadcast naturally and
+    # scalar rank fields stay scalar (materializing 14 full-size arrays
+    # per call measured as a top indexing cost)
+    shape = np.broadcast_shapes(
+        termid.shape, docid.shape, wordpos.shape, densityrank.shape,
+        diversityrank.shape, wordspamrank.shape, siterank.shape,
+        hashgroup.shape, langid.shape, multiplier.shape, synform.shape,
+        outlink.shape, shardbytermid.shape, delbit.shape)
 
     n2 = (termid << np.uint64(16)) | (docid >> np.uint64(22))
     n1 = (
@@ -141,7 +147,7 @@ def pack(
         | (((langid >> np.uint64(5)) & np.uint64(1)) << np.uint64(3))
         | (delbit & np.uint64(1))
     )
-    out = np.empty(n2.shape, dtype=KEY_DTYPE)
+    out = np.empty(shape, dtype=KEY_DTYPE)
     out["n0"] = n0.astype(np.uint16)
     out["n1"] = n1
     out["n2"] = n2

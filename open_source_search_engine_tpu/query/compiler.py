@@ -113,20 +113,38 @@ class TermGroup:
     def termids(self) -> list[int]:
         return [s.termid for s in self.sublists]
 
-    def slot_plan(self, max_positions: int = 16) -> list[tuple[int, int]]:
+    def slot_plan(self, max_positions: int = 16,
+                  present: list[bool] | None = None
+                  ) -> list[tuple[int, int]]:
         """[(slot_base, quota)] per sublist: the ORIGINAL word keeps at
         least half the position budget; bigram/synonym variants split
         the rest (a spammy variant must never starve the primary word —
-        the reference's mini-merge buffers are per-sublist too)."""
+        the reference's mini-merge buffers are per-sublist too).
+
+        ``present`` marks sublists that actually have postings: absent
+        variants get quota 0 instead of reserving dead slots, so a word
+        whose synonyms don't occur in the corpus keeps the FULL position
+        budget (the reference's mini-merge has no such reservation —
+        slots are a packing artifact here). Callers on every path (host
+        packer, device planner) pass the same mask, so parity holds."""
         subs = self.sublists
-        if len(subs) <= 1:
-            return [(0, max_positions)] * len(subs)
-        n_var = len(subs) - 1
-        prim = max(max_positions // 2, 1)
-        var = max((max_positions - prim) // n_var, 1)
+        if present is None:
+            present = [True] * len(subs)
+        live = [s for s, p in zip(subs, present) if p]
+        if len(live) <= 1:
+            return [(0, max_positions if p else 0) for p in present]
+        n_var = sum(1 for s, p in zip(subs, present)
+                    if p and s.kind != SUB_ORIGINAL)
+        any_prim = any(p and s.kind == SUB_ORIGINAL
+                       for s, p in zip(subs, present))
+        prim = max(max_positions // 2, 1) if any_prim else 0
+        var = max((max_positions - prim) // max(n_var, 1), 1)
         out = []
         base = 0
-        for s in subs:
+        for s, p in zip(subs, present):
+            if not p:
+                out.append((min(base, max_positions - 1), 0))
+                continue
             q = prim if s.kind == SUB_ORIGINAL else var
             out.append((min(base, max_positions - 1), q))
             base += q

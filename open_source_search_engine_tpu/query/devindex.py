@@ -135,7 +135,16 @@ DENSE_MIN_DF = 1024
 LSP_MAX = 2048
 
 #: HBM budget for materialized [P, D_cap] cube rows (P·4 bytes/doc/term)
-CUBE_BUDGET_BYTES = 768 << 20
+#: — sized so most corpus-wide drivers resolve through the direct
+#: quarter-gather kernel instead of the assembling F2 (v5e has 16 GB;
+#: dense rows take ~1.5 GB, columns ~0.5 GB, working set ~2 GB)
+CUBE_BUDGET_BYTES = 3 << 30
+
+#: direct-kernel scatter tail budget: total non-cube postings a query
+#: may scatter into its quarter-built plane before falling back to the
+#: generic F2 (scalar scatter runs ~10 Melem/s — keep the tail small)
+FD_SCATTER_MAX_LANES = 32768
+FD_SCATTER_MAX_ROWS = 32
 
 #: routing: drivers at or below this df use phase-1 pruning (F1);
 #: bigger drivers go to the full-cube kernel (F2), whose cost is flat
@@ -177,49 +186,52 @@ def _posscore_np(f: dict[str, np.ndarray]) -> np.ndarray:
 
 def _impacts_np(f: dict[str, np.ndarray], termids: np.ndarray,
                 docidx: np.ndarray, runstart: np.ndarray) -> np.ndarray:
-    """Admissible per-(term, doc) single-score bound, TIGHT: Σ over the
-    top-MAX_TOP of {per-mapped-hashgroup position maxima} ∪ {every
-    inlink-text occurrence} — exactly the candidate set
-    getSingleTermScore tops-and-sums (Posdb.cpp:3087). With the cut
-    applied the bound equals the exact single-term score up to float
-    association, so single-group queries prune at the smallest κ rung
-    (the candidate pass ranks them essentially exactly)."""
+    """EXACT per-(term, doc) single-term score (pre-freq-weight): Σ over
+    the top-MAX_TOP of {per-mapped-hashgroup position maxima} ∪ {every
+    inlink-text occurrence individually} — exactly the candidate set
+    getSingleTermScore tops-and-sums (Posdb.cpp:3087), exactly cut.
+    Equal (mod float association) to what scorer.min_scores computes
+    from the stored positions, so (a) it is an admissible AND tight
+    phase-1 bound, and (b) the direct-cube kernel can use it AS the
+    single-term score without touching positions."""
     n = len(termids)
     if n == 0:
         return np.empty(0, np.float32)
     ps = _posscore_np(f)
     mhg = weights.MAPPED_HASHGROUP[f["hashgroup"]].astype(np.int8)
     is_inlink = f["hashgroup"] == posdb.HASHGROUP_INLINKTEXT
-    # order within each (term, doc) run by mapped hashgroup: runs are
-    # tiny (≤ P) so a stable argsort of the group key within runs via
-    # one global lexsort is fine
+    # candidate pool per (term, doc): one max per non-inlink mapped
+    # hashgroup + each inlink occurrence individually. Build it by
+    # collapsing non-inlink (term, doc, mhg) groups to their max and
+    # keeping inlink rows as-is, then rank within (term, doc).
     o = np.lexsort((mhg, docidx, termids))
     ps_o, mh_o, il_o = ps[o], mhg[o], is_inlink[o]
     t_o, d_o = termids[o], docidx[o]
     gch = np.ones(n, bool)
     gch[1:] = ((t_o[1:] != t_o[:-1]) | (d_o[1:] != d_o[:-1])
                | (mh_o[1:] != mh_o[:-1]))
+    # candidates: non-inlink groups contribute their first-row slot
+    # (value = group max); inlink rows contribute every row
+    gid = np.cumsum(gch) - 1
     gstart = np.nonzero(gch)[0]
     gmax = np.maximum.reduceat(ps_o, gstart)
-    gsum = np.add.reduceat(ps_o, gstart)
-    gval = np.where(il_o[gstart], gsum, gmax)
-    # inlink groups contribute each occurrence separately to the
-    # top-MAX_TOP candidate pool; approximate their pool entry by the
-    # whole-group sum (≥ exact, still admissible; non-inlink docs —
-    # the overwhelming majority — get the exact cut)
-    pch = np.ones(len(gstart), bool)
-    pch[1:] = ((t_o[gstart][1:] != t_o[gstart][:-1])
-               | (d_o[gstart][1:] != d_o[gstart][:-1]))
+    cand_mask = il_o | gch
+    cval = np.where(il_o, ps_o, gmax[gid])[cand_mask]
+    ct = t_o[cand_mask]
+    cd = d_o[cand_mask]
+    m = len(cval)
+    # rank candidates within each (term, doc) pair (descending) and
+    # zero everything past MAX_TOP before the pair sum
+    pch = np.ones(m, bool)
+    pch[1:] = (ct[1:] != ct[:-1]) | (cd[1:] != cd[:-1])
     pstart = np.nonzero(pch)[0]
-    pair_id = np.cumsum(pch) - 1               # group → owning pair
-    # rank each group's value within its pair (descending) and zero
-    # everything past MAX_TOP before the pair sum
-    order2 = np.lexsort((-gval, pair_id))
-    ranked = np.empty(len(gval), np.int64)
-    pos_in_pair = np.arange(len(gval)) - pstart[pair_id[order2]]
+    pair_id = np.cumsum(pch) - 1               # candidate → owning pair
+    order2 = np.lexsort((-cval, pair_id))
+    ranked = np.empty(m, np.int64)
+    pos_in_pair = np.arange(m) - pstart[pair_id[order2]]
     ranked[order2] = pos_in_pair
-    gval_cut = np.where(ranked < weights.MAX_TOP, gval, 0.0)
-    imp = np.add.reduceat(gval_cut, pstart)
+    cval_cut = np.where(ranked < weights.MAX_TOP, cval, 0.0)
+    imp = np.add.reduceat(cval_cut, pstart)
     assert len(imp) == len(runstart)
     # tiny floor keeps zero-weight hashgroups present-but-worthless
     return np.maximum(imp, 1e-30).astype(np.float32)
@@ -385,6 +397,20 @@ class ResidentPlan:
     matchable: bool
     driver_df: int = 0       # min required-group df (routes F1 vs F2)
     kappa_min: int = 0       # escalation floor (set on a pruning miss)
+    k2_min: int = 0          # phase-2 width floor (escalates with κ so
+    #                          the terminal rung scores everything and
+    #                          the ladder stays lossless)
+    #: direct-cube (FD) eligibility: every group's contributing runs
+    #: are base cube rows whose slot_plan layout is quarter-aligned
+    #: (1 sublist = full row, 2 = half+half, 3 = half+quarter+quarter).
+    #: The group's [P, D] plane is then FOUR quarter-row gathers from
+    #: the resident cube — no per-query cube assembly at all.
+    direct_ok: bool = False
+    g_quarter: np.ndarray | None = None  # int32 [T, 4] absolute quarter
+    g_qsyn: np.ndarray | None = None     # uint32 [T, 4] synonym flags
+    #: True only for boolean queries — non-boolean waves compile the
+    #: truth-table gate out (its [D]-wide gather costs ~140 ms/wave)
+    has_table: bool = False
 
 
 class DeviceIndex:
@@ -434,7 +460,7 @@ class DeviceIndex:
         return True
 
     #: bump when any derived-column computation changes (cache schema)
-    _CACHE_SCHEMA = 2  # v2: top-MAX_TOP-cut impacts
+    _CACHE_SCHEMA = 3  # v3: exact (per-inlink-occurrence) impacts
 
     def _cache_path(self, fp):
         import hashlib
@@ -590,7 +616,10 @@ class DeviceIndex:
         # columns — no multi-hundred-MB host upload ---
         cube_budget = max(CUBE_BUDGET_BYTES // (P * self.D_cap * 4), 1)
         cube_terms = dense_terms[:cube_budget]
-        Vc = _bucket(max(len(cube_terms), 1), 4)
+        # +1: the last slot stays all-zero — the FD kernel's "absent
+        # quarter" target (zero payload = invalid by convention)
+        Vc = _bucket(len(cube_terms) + 1, 4)
+        self.cube_zero_slot = Vc - 1
         self.cube_slot_of: dict[int, int] = {}
         cube_src: list[np.ndarray] = []
         cube_dst: list[np.ndarray] = []
@@ -897,16 +926,34 @@ class DeviceIndex:
         any_required = False
         driver_df = 1 << 60
         groups_have_postings = []
+        # direct-cube qualification: per group, the contributing runs
+        zq = 4 * getattr(self, "cube_zero_slot", 0)
+        g_quarter = np.full((T, 4), zq, np.int32)
+        g_qsyn = np.zeros((T, 4), np.uint32)
+        direct_ok = True
         for g_i, g in enumerate(qplan.groups):
             subs = g.sublists
-            sp = g.slot_plan(self.P)
+            sub_druns = [self._druns_of(s.termid) for s in subs]
+            # quota only over sublists with LIVE postings — df under
+            # tombstones, matching the host packer's fetched-list mask
+            # (a sublist whose every doc was deleted still has base
+            # runs in the directory, but its merged host list is empty;
+            # diverging masks would give the two paths different slot
+            # plans and break parity)
+            sp = g.slot_plan(
+                self.P,
+                present=[bool(d) and self._df_of(s.termid) > 0
+                         for s, d in zip(subs, sub_druns)])
             any_postings = False
             gdf = 0
+            g_runs = []
             for s_i, sub in enumerate(subs):
                 syn = 1 if sub.kind == SUB_SYNONYM else 0
                 base, quota = sp[s_i]
                 for is_base, a, ln, dslot, cslot, pa, pl in \
-                        self._druns_of(sub.termid):
+                        sub_druns[s_i]:
+                    g_runs.append((is_base, dslot, cslot, syn, base,
+                                   quota))
                     # F1 row split: dense [D] impact row vs sparse run.
                     # Sparse runs chunk at LSP_MAX so the lane bucket is
                     # a constant (one compile) and an unbudgeted big
@@ -938,11 +985,34 @@ class DeviceIndex:
                 gdf = max(gdf, self._df_of(sub.termid))
             dfs[g_i] = gdf
             groups_have_postings.append(any_postings)
+            # direct-cube qualification: cube runs must be base runs at
+            # quarter-aligned (base, quota) so the group plane assembles
+            # from quarter-row gathers (quarter q of a term's [P, D]
+            # cube row holds its occurrences q·P/4..); non-cube runs go
+            # to the bounded posting-scatter tail (checked globally
+            # below). Misaligned cube runs → generic F2.
+            P4 = self.P // 4
+            for is_b, dsl, csl, syn, base, quota in g_runs:
+                if csl < 0:
+                    continue  # scatter-tail run (prows carry it)
+                if not (is_b and base % P4 == 0 and quota % P4 == 0
+                        and quota > 0 and base + quota <= self.P):
+                    direct_ok = False
+                    continue
+                for k in range(min(quota, self.P - base) // P4):
+                    g_quarter[g_i, base // P4 + k] = 4 * csl + k
+                    g_qsyn[g_i, base // P4 + k] = syn
             if g.required and not g.negative:
                 any_required = True
                 driver_df = min(driver_df, gdf)
                 if not any_postings:
                     matchable = False
+        # direct route needs the scatter tail bounded: big non-cube doc
+        # runs (a heavy term outside the cube budget) must assemble
+        # through the generic F2
+        if (len(prows) > FD_SCATTER_MAX_ROWS
+                or sum(p[1] for p in prows) > FD_SCATTER_MAX_LANES):
+            direct_ok = False
         if qplan.bool_table is not None:
             # a boolean query is servable iff SOME satisfying presence
             # assignment uses only groups that have postings; the match
@@ -995,7 +1065,9 @@ class DeviceIndex:
             scored=scored, counts=counts,
             table=pad_table(qplan.bool_table),
             qlang=qplan.lang, matchable=matchable,
-            driver_df=0 if driver_df == 1 << 60 else int(driver_df))
+            driver_df=0 if driver_df == 1 << 60 else int(driver_df),
+            direct_ok=direct_ok, g_quarter=g_quarter, g_qsyn=g_qsyn,
+            has_table=qplan.bool_table is not None)
 
     # --- execution -------------------------------------------------------
 
@@ -1027,7 +1099,20 @@ class DeviceIndex:
         # κ=8192, so only genuinely corpus-wide drivers route to F2
         f2_cut = min(4 * CUBE_MIN_DF,
                      max(2 * KAPPA_FLOOR, self.n_docs // 8))
-        f2 = [i for i in live if plans[i].driver_df > f2_cut]
+
+        def _route_f2(i):
+            p = plans[i]
+            if p.driver_df > f2_cut:
+                return True
+            # heavy multi-group queries that CAN go direct should: the
+            # F1 ladder would score a ≥2048-wide phase 2 with loose
+            # distance-free bounds (escalation-prone); the direct
+            # kernel scores the whole corpus exactly at flat cost and
+            # never rungs up
+            return (p.direct_ok and int(np.sum(p.counts)) > 1
+                    and self._kappa_of(p, topk) >= 8 * KAPPA_FLOOR)
+
+        f2 = [i for i in live if _route_f2(i)]
         f1 = [i for i in live if i not in set(f2)]
 
         # wave loop: issue EVERY sub-batch dispatch, fetch ALL outputs
@@ -1038,11 +1123,13 @@ class DeviceIndex:
         # k is bucketed (floor 64, powers of 2) so arbitrary caller topk
         # values don't mint new compile variants; extra rows returned
         # beyond the caller's k are harmless. The KERNEL k2 is pinned to
-        # one 256-row value for everyday requests (n ≤ 100 over any s
+        # one 128-row value for everyday requests (n ≤ 100 over any s
         # ≤ topk·2 stays under it), so k2 never multiplies the compile
-        # grid; only genuinely deep pages mint a bigger variant
+        # grid; only genuinely deep pages mint a bigger variant. k2 is
+        # also the phase-2 scoring width (top-k2 by bound), so it sets
+        # the dominant gather cost — 128 balances margin vs wave time
         k_req = min(_bucket(max(topk, 1), 64), self.D_cap)
-        k2v = min(max(256, k_req), self.D_cap)
+        k2v = min(max(128, k_req), self.D_cap)
         # deep paging (TopTree top-X, X ≫ page): start the F2 selection
         # rung at the requested depth so page-50 doesn't climb a ladder
         f2_nsel = min(max(2048, _bucket(k_req, 2048)), self.D_cap)
@@ -1050,46 +1137,82 @@ class DeviceIndex:
         while f1 or f2:
             t_issue = time.perf_counter()
             waves = []
-            groups: dict[int, list[int]] = {}
+            groups: dict[tuple[int, int], list[int]] = {}
             for i in f1:
-                groups.setdefault(self._kappa_of(plans[i], topk),
-                                  []).append(i)
-            for kappa, idxs in sorted(groups.items()):
-                # big-κ rungs (escalations, deep paging) drop to B=4 so
-                # the [T, P, κ]·B phase-2 intermediates stay bounded
-                step = 32 if kappa <= 32 * KAPPA_FLOOR else 4
+                kapi = self._kappa_of(plans[i], topk)
+                # phase-2 truncation to the top-k2 BY BOUND is only
+                # sound-in-practice for single-scored-group plans,
+                # where the bound ≈ the exact score; multi-group pair
+                # bounds are distance-free (up to ~400× loose), so
+                # bound order ≉ exact order and truncation would
+                # escalate nearly every query (measured 57%). Multi-
+                # group plans score every selected candidate.
+                if int(np.sum(plans[i].counts)) <= 1:
+                    k2i = min(max(k2v, plans[i].k2_min), kapi)
+                else:
+                    k2i = kapi
+                groups.setdefault(
+                    (kapi, k2i, plans[i].has_table), []).append(i)
+            for (kappa, k2g, _ut), idxs in sorted(groups.items()):
+                # terminal rungs chunk at 4 so the [T, P, k2]·B
+                # phase-2 intermediates stay bounded at k2 = D_cap
+                step = 64 if k2g <= 32 * KAPPA_FLOOR else 4
                 for a in range(0, len(idxs), step):
                     chunk = idxs[a:a + step]
-                    waves.append(("f1", kappa, chunk, self._run_batch(
-                        [plans[i] for i in chunk], kappa,
-                        min(k2v, kappa))))
-            for a in range(0, len(f2), bmax):
-                chunk = f2[a:a + bmax]
-                waves.append(("f2", 0, chunk, self._run_batch_f2(
+                    waves.append(("f1", kappa, k2g, chunk,
+                                  self._run_batch(
+                                      [plans[i] for i in chunk],
+                                      kappa, k2g)))
+            fd = [i for i in f2 if plans[i].direct_ok]
+            fg = [i for i in f2 if not plans[i].direct_ok]
+            # group FD waves by scatter-tail size: the Lp lane bucket is
+            # per-wave, so one heavy-tailed query must not make every
+            # lane of its wave pay 16384-lane scatters
+            def _lp_of(i):
+                p = plans[i]
+                ml = int(p.p_len.max()) if len(p.p_len) else 0
+                return 512 if ml <= 512 else (
+                    F2_LPOST_FLOOR if ml <= F2_LPOST_FLOOR
+                    else F2_SCATTER_MAX)
+            fd.sort(key=lambda i: (_lp_of(i), plans[i].has_table))
+            fg.sort(key=lambda i: plans[i].has_table)
+            for a in range(0, len(fd), 16):
+                chunk = fd[a:a + 16]
+                waves.append(("f2", 0, k2v, chunk, self._run_batch_fd(
+                    [plans[i] for i in chunk], k2v, f2_nsel)))
+            for a in range(0, len(fg), bmax):
+                chunk = fg[a:a + bmax]
+                waves.append(("f2", 0, k2v, chunk, self._run_batch_f2(
                     [plans[i] for i in chunk], k2v, f2_nsel)))
             g_stats.record_ms("devindex.issue",
                               1000 * (time.perf_counter() - t_issue))
             t_fetch = time.perf_counter()
-            outs = jax.device_get([w[3] for w in waves])
+            outs = jax.device_get([w[4] for w in waves])
             g_stats.record_ms(
                 "devindex.wave_" + "+".join(sorted({w[0] for w in waves}))
                 + f"_n{len(waves)}",
                 1000 * (time.perf_counter() - t_fetch))
             f1_next: list[int] = []
             f2_next: list[int] = []
-            for (kind, kappa, idxs, _), out in zip(waves, outs):
-                k2 = min(k2v, kappa) if kind == "f1" else k2v
+            for (kind, kappa, k2g, idxs, _), out in zip(waves, outs):
                 for row, i in zip(out, idxs):
-                    k2p = min(k2, f2_nsel, self.D_cap) if kind == "f2" \
-                        else k2
+                    k2p = min(k2g, f2_nsel, self.D_cap) if kind == "f2" \
+                        else k2g
                     nm, missed, idx, scores = self._parse_out(row, k2p)
                     kth = float(scores[k_req - 1]) if (
                         k2p >= k_req and scores[k_req - 1] > 0.0) else 0.0
                     if missed > kth * _TIE_TOL:
-                        if kind == "f1" and kappa < self.D_cap:
-                            # pruning miss — widen the κ rung and rerun
+                        if kind == "f1" and (kappa < self.D_cap
+                                             or k2p < self.D_cap):
+                            # pruning miss — widen the κ rung AND the
+                            # phase-2 width, rerun; terminal at
+                            # κ = k2 = D_cap where scoring is complete
+                            # and missed is exactly 0
                             plans[i].kappa_min = min(4 * kappa,
                                                      self.D_cap)
+                            plans[i].k2_min = min(
+                                4 * max(k2p, KAPPA_FLOOR // 2),
+                                self.D_cap)
                             f1_next.append(i)
                             continue
                         if kind == "f2" and f2_nsel < self.D_cap:
@@ -1141,36 +1264,68 @@ class DeviceIndex:
                 matchable=True)
 
         outs = []
-        k2 = min(256, self.D_cap)
-        shape_grid = ((1, 1), (5, 1), (1, 5), (5, 5), (17, 1))
-        for ns, nd in shape_grid:  # κ=256 rung: B=32 always
-            outs.append(self._run_batch(
-                [dummy(ns=ns, nd=nd)], min(KAPPA_FLOOR, self.D_cap),
-                min(k2, KAPPA_FLOOR)))
+        k2 = min(128, self.D_cap)
+        kap = min(KAPPA_FLOOR, self.D_cap)
+        shape_grid = ((1, 1), (2, 1), (1, 2), (3, 3), (5, 5), (17, 1))
+        for ns, nd in shape_grid:          # κ=256 base rung
+            for nb in (1, 5, 9, 33):       # B = 4 / 8 / 32 / 64
+                # single-group (k2=128) AND multi-group (k2=κ) widths
+                outs.append(self._run_batch(
+                    [dummy(ns=ns, nd=nd)] * nb, kap, min(k2, kap)))
+                outs.append(self._run_batch(
+                    [dummy(ns=ns, nd=nd)] * nb, kap, kap))
         kap8 = min(KAPPA_FLOOR * 8, self.D_cap)
-        for ns, nd in shape_grid:  # κ=2048 rung, B=8 (≤8 real queries)
-            outs.append(self._run_batch(
-                [dummy(ns=ns, nd=nd)], kap8, min(k2, kap8)))
-        for ns, nd in ((1, 1), (5, 1), (5, 5)):  # κ=2048, B=32
-            outs.append(self._run_batch(
-                [dummy(ns=ns, nd=nd)] * 9, kap8, min(k2, kap8)))
+        for ns, nd in ((1, 1), (2, 1), (1, 2), (3, 3)):  # κ=2048 rung
+            for nb in (1, 5, 9, 33):     # B = 4 / 8 / 32 / 64
+                outs.append(self._run_batch(
+                    [dummy(ns=ns, nd=nd)] * nb, kap8, min(k2, kap8)))
+                outs.append(self._run_batch(
+                    [dummy(ns=ns, nd=nd)] * nb, kap8, kap8))
+        # escalation rungs: (κ, k2) widen together, B=4 (few escapees)
         kap32 = min(KAPPA_FLOOR * 32, self.D_cap)
-        outs.append(self._run_batch([dummy()], kap32, min(k2, kap32)))
-        outs.append(self._run_batch([dummy()] * 9, kap32,
-                                    min(k2, kap32)))
-        kap128 = min(KAPPA_FLOOR * 128, self.D_cap)
-        outs.append(self._run_batch([dummy()], kap128,
-                                    min(k2, kap128)))
+        outs.append(self._run_batch([dummy()], kap8,
+                                    min(KAPPA_FLOOR * 2, kap8)))
+        outs.append(self._run_batch([dummy()], kap32,
+                                    min(KAPPA_FLOOR * 8, kap32)))
+        for ns, nd in ((1, 1), (2, 1), (3, 3)):  # multi-group escapees
+            outs.append(self._run_batch([dummy(ns=ns, nd=nd)], kap32,
+                                        kap32))
+            outs.append(self._run_batch([dummy(ns=ns, nd=nd)] * 5,
+                                        kap32, kap32))
         for n_sel in (2048, 8192):  # F2 base + first escalation rung
             for np_rows in (1, 9):
-                p = dummy(np_rows=np_rows)
-                p.p_len[:] = 1
-                outs.append(self._run_batch_f2(
-                    [p], k2, min(n_sel, self.D_cap)))
-                p2 = dummy(np_rows=np_rows)
-                p2.p_len[0] = F2_LPOST_FLOOR + 1  # big-Lp bucket
-                outs.append(self._run_batch_f2(
-                    [p2], k2, min(n_sel, self.D_cap)))
+                for nb in (1, 5):  # B = 4 and B = bmax buckets
+                    p = dummy(np_rows=np_rows)
+                    p.p_len[:] = 1
+                    outs.append(self._run_batch_f2(
+                        [p] * nb, k2, min(n_sel, self.D_cap)))
+                    p2 = dummy(np_rows=np_rows)
+                    p2.p_len[0] = F2_LPOST_FLOOR + 1  # big-Lp bucket
+                    outs.append(self._run_batch_f2(
+                        [p2] * nb, k2, min(n_sel, self.D_cap)))
+        # FD (direct-cube) shapes: B = 4 and B = 16 buckets, with and
+        # without scatter tails (delta postings put every fresh write
+        # on the tail, so the Lp=512 and Lp=4096 variants are everyday)
+        pd = dummy()
+        pd.g_quarter = np.zeros((T, 4), np.int32)
+        pd.g_qsyn = np.zeros((T, 4), np.uint32)
+        pt = dummy(np_rows=5)  # Rp=8 bucket
+        pt.g_quarter = np.zeros((T, 4), np.int32)
+        pt.g_qsyn = np.zeros((T, 4), np.uint32)
+        pt.p_len[:] = 1
+        pl = dummy()
+        pl.g_quarter = np.zeros((T, 4), np.int32)
+        pl.g_qsyn = np.zeros((T, 4), np.uint32)
+        pl.p_len[0] = 513  # Lp=4096 bucket
+        for n_sel in (2048, 8192):
+            for nb in (1, 5):
+                outs.append(self._run_batch_fd(
+                    [pd] * nb, k2, min(n_sel, self.D_cap)))
+                if n_sel == 2048:
+                    outs.append(self._run_batch_fd(
+                        [pt] * nb, k2, min(n_sel, self.D_cap)))
+                    outs.append(self._run_batch_fd(
+                        [pl] * nb, k2, min(n_sel, self.D_cap)))
         jax.device_get(outs)
         return len(outs)
 
@@ -1227,21 +1382,27 @@ class DeviceIndex:
         # ~60 s tunnel compile (run-to-run bench variance traced to
         # exactly that)
         mrd = max([len(p.d_slot) for p in plans] + [1])
-        Rd = 4 if mrd <= 4 else (16 if mrd <= 16 else _bucket(mrd, 64))
+        Rd = 2 if mrd <= 2 else (4 if mrd <= 4 else (
+            16 if mrd <= 16 else _bucket(mrd, 64)))
         mrs = max([len(p.s_start) for p in plans] + [1])
-        Rs = 4 if mrs <= 4 else (16 if mrs <= 16 else _bucket(mrs, 64))
+        Rs = 2 if mrs <= 2 else (4 if mrs <= 4 else (
+            16 if mrs <= 16 else _bucket(mrs, 64)))
         Lsp = LSP_FLOOR  # runs chunk at LSP_MAX == LSP_FLOOR (plan)
         T = max(len(p.required) for p in plans)
-        # B buckets: phase-2 gathers cost ∝ B·κ INCLUDING pad lanes, so
-        # a κ≥2048 wave with few real queries pads to 8, not 32 (the
-        # κ=2048+ rungs usually hold the minority of a batch); the
-        # terminal rungs drop to B=4 to bound [T, P, κ]·B memory
-        if kappa > 32 * KAPPA_FLOOR:
+        # B buckets: every per-lane cost (phase-1 chains, phase-2
+        # gathers) scales with B INCLUDING pad lanes, while the ~105 ms
+        # tunnel RTT is fixed — so big batches amortize, small ones
+        # (single-query latency, minority rungs) drop to B=4. κ no
+        # longer constrains B: phase 2 is k2-wide (k2 ≪ κ), so big-κ
+        # rungs only pay a wider selection pass
+        if len(plans) <= 4:
             B = 4
-        elif kappa >= 8 * KAPPA_FLOOR and len(plans) <= 8:
+        elif len(plans) <= 8:
             B = 8
-        else:
+        elif len(plans) <= 32:
             B = 32
+        else:
+            B = 64
 
         def pad_plan(p: ResidentPlan | None):
             if p is None:
@@ -1274,6 +1435,14 @@ class DeviceIndex:
         padded = [pad_plan(p) for p in plans] \
             + [pad_plan(None)] * (B - len(plans))
         args = [np.stack([p[j] for p in padded]) for j in range(19)]
+        # few-hot selector for the phase-1 dense matmul: one 1.0 per
+        # dense row occurrence at (query, group, dense slot)
+        V = self.d_dense_imp.shape[0]
+        sel = np.zeros((B, T, V), np.float32)
+        for b, p in enumerate(plans):
+            for slot, g in zip(p.d_slot, p.d_group):
+                if slot >= 0:
+                    sel[b, g, slot] += 1.0
         log.debug("f1 wave: B=%d Rd=%d Rs=%d Lsp=%d kappa=%d k2=%d",
                   B, Rd, Rs, Lsp, kappa, k2)
         # host args ride the (async) dispatch; returned WITHOUT fetching
@@ -1283,8 +1452,9 @@ class DeviceIndex:
             self.d_payload, self.d_doc, self.d_imp, self.d_rsp,
             self.d_dense_imp, self.d_dense_rsp,
             self.d_siterank, self.d_doclang, self.d_dead,
-            np.int32(self.n_docs), *args,
-            n_positions=self.P, lsp=Lsp, kappa=kappa, k2=k2)
+            np.int32(self.n_docs), sel, *args,
+            n_positions=self.P, lsp=Lsp, kappa=kappa, k2=k2,
+            use_table=any(p.has_table for p in plans))
 
     def _run_batch_f2(self, plans: list[ResidentPlan], k2: int,
                       n_sel: int):
@@ -1295,7 +1465,9 @@ class DeviceIndex:
                       for p in plans] + [1])
         Lp = F2_LPOST_FLOOR if maxlen <= F2_LPOST_FLOOR else F2_SCATTER_MAX
         T = max(len(p.required) for p in plans)
-        B = self._f2_bmax()  # ONE B bucket per corpus size
+        # two B buckets: the latency path (≤4 real queries) must not
+        # pay a full B=bmax wave of [T, P, D] work for its pad lanes
+        B = 4 if len(plans) <= 4 else self._f2_bmax()
 
         def pad_plan(p: ResidentPlan | None):
             if p is None:
@@ -1335,7 +1507,64 @@ class DeviceIndex:
             self.d_dense_rsp, self.d_siterank, self.d_doclang,
             self.d_dead, np.int32(self.n_docs), *args,
             n_positions=self.P, lpost=Lp, k2=k2,
-            n_sel=min(n_sel, self.D_cap))
+            n_sel=min(n_sel, self.D_cap),
+            use_table=any(p.has_table for p in plans))
+
+    def _run_batch_fd(self, plans: list[ResidentPlan], k2: int,
+                      n_sel: int):
+        """Direct-cube (FD) wave: heavy sublists read as quarter-rows
+        of the resident cube, small ones ride a bounded scatter tail —
+        no per-query cube assembly."""
+        T = max(len(p.required) for p in plans)
+        B = 4 if len(plans) <= 4 else 16
+        zq = 4 * getattr(self, "cube_zero_slot", 0)
+        cs = np.full((B, T, 4), zq, np.int32)
+        sy = np.zeros((B, T, 4), np.uint32)
+        for b, p in enumerate(plans):
+            cs[b, : len(p.g_quarter)] = p.g_quarter
+            sy[b, : len(p.g_qsyn)] = p.g_qsyn
+        mrp = max([len(p.p_start) for p in plans] + [1])
+        Rp = 4 if mrp <= 4 else _bucket(mrp, 8)
+        maxlen = max([int(p.p_len.max()) if len(p.p_len) else 1
+                      for p in plans] + [1])
+        Lp = 512 if maxlen <= 512 else (
+            F2_LPOST_FLOOR if maxlen <= F2_LPOST_FLOOR
+            else F2_SCATTER_MAX)
+
+        def pad_plan(p: ResidentPlan | None):
+            if p is None:
+                return (np.zeros(Rp, np.int32), np.zeros(Rp, np.int32),
+                        np.zeros(Rp, np.int32), np.zeros(Rp, np.int32),
+                        np.ones(Rp, np.int32), np.zeros(Rp, np.uint32),
+                        np.ones(Rp, bool),
+                        np.full(T, 0.5, np.float32), np.zeros(T, bool),
+                        np.zeros(T, bool), np.zeros(T, bool),
+                        np.zeros(T, bool), np.ones(TABLE_SIZE, bool),
+                        np.int32(0))
+            pr = lambda a, n, fill: _pad1(a, n, fill)
+            return (pr(p.p_start, Rp, 0), pr(p.p_len, Rp, 0),
+                    pr(p.p_group, Rp, 0), pr(p.p_base, Rp, 0),
+                    pr(p.p_quota, Rp, 1), pr(p.p_syn, Rp, 0),
+                    pr(p.p_isbase, Rp, True),
+                    _pad1(p.freq_weight, T, 0.5),
+                    _pad1(p.required, T, False),
+                    _pad1(p.negative, T, False),
+                    _pad1(p.scored, T, False),
+                    _pad1(p.counts, T, False), p.table,
+                    np.int32(p.qlang))
+
+        padded = [pad_plan(p) for p in plans] \
+            + [pad_plan(None)] * (B - len(plans))
+        args = [np.stack([p[j] for p in padded]) for j in range(14)]
+        log.debug("fd wave: B=%d T=%d Rp=%d Lp=%d k2=%d n_sel=%d",
+                  B, T, Rp, Lp, k2, n_sel)
+        return _direct_cube(
+            self.d_cube, self.d_payload, self.d_pdoc, self.d_pocc,
+            self.d_siterank, self.d_doclang, self.d_dead,
+            np.int32(self.n_docs), cs, sy, *args,
+            n_positions=self.P, lpost=Lp, k2=k2,
+            n_sel=min(n_sel, self.D_cap),
+            use_table=any(p.has_table for p in plans))
 
 
 @jax.jit
@@ -1343,13 +1572,15 @@ def _apply_doc_meta(sr, dl, idx, vsr, vdl):
     return sr.at[idx].set(vsr), dl.at[idx].set(vdl)
 
 
-@partial(jax.jit, static_argnames=("n_positions", "lsp", "kappa", "k2"))
+@partial(jax.jit, static_argnames=("n_positions", "lsp", "kappa", "k2",
+                                   "use_table"))
 def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
-               d_siterank, d_doclang, d_dead, n_docs_total,
+               d_siterank, d_doclang, d_dead, n_docs_total, d_sel,
                d_slot, d_group, d_base, d_quota, d_syn,
                s_start, s_len, s_group, s_base, s_quota, s_syn, s_isbase,
                freqw, required, negative, scored, counts, table, qlang,
-               n_positions: int, lsp: int, kappa: int, k2: int):
+               n_positions: int, lsp: int, kappa: int, k2: int,
+               use_table: bool = True):
     """The fused two-phase kernel, vmapped over the query axis.
 
     Phase 1 = dense upper bounds + intersection + approx top-κ (the
@@ -1364,7 +1595,20 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
     P = n_positions
     big = jnp.float32(9.99e8)
 
-    def one(d_slot, d_group, d_base, d_quota, d_syn,
+    # ---- phase 1 dense accumulation as ONE matmul on the MXU:
+    # ubb[b, t, :] = Σ_v sel[b, t, v] · dense_imp[v, :]. The selector
+    # [B·T, V] is a few-hot host-built matrix; the whole batch reads
+    # the [V, D] impact matrix ONCE at bandwidth speed. The former
+    # per-row dynamic slices cost ~91 ms/wave at B=32 (per-lane row
+    # copies); this is ~1 ms. HIGHEST precision keeps f32 exactness —
+    # the bound must never dip below the exact score (admissibility).
+    B, Ts, _ = d_sel.shape
+    ubb_mm = jax.lax.dot_general(
+        d_sel.reshape(B * Ts, V), d_dense_imp,
+        (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST).reshape(B, Ts, D)
+
+    def one(ubb, d_slot, d_group, d_base, d_quota, d_syn,
             s_start, s_len, s_group, s_base, s_quota, s_syn, s_isbase,
             freqw, required, negative, scored, counts, table, qlang):
         T = required.shape[0]
@@ -1374,20 +1618,9 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
         live = ~d_dead                                        # [D]
 
         # ---- phase 1: group upper bounds over the full doc axis,
-        # base and delta separated so dead docs mask only the base ----
-        # dense rows come out of [V, D] via EXPLICIT dynamic slices:
-        # a traced-index row gather ([Rd, D] in one op) lowers to
-        # per-element gather on TPU (~60 Melem/s — measured to dominate
-        # the wave); a dynamic slice is a bandwidth-speed row copy
-        ubb = jnp.zeros((T, D), jnp.float32)
+        # base and delta separated so dead docs mask only the base
+        # (dense-row part arrives precomputed from the batch matmul) ----
         dgate = (d_slot >= 0)
-        for r in range(Rd):
-            row = jax.lax.dynamic_index_in_dim(
-                d_dense_imp, jnp.clip(d_slot[r], 0, V - 1), axis=0,
-                keepdims=False)
-            contrib = jnp.where(dgate[r], row, 0.0)
-            ubb = ubb + jnp.where((d_group[r] == t_ax)[:, None],
-                                  contrib[None, :], 0.0)
         # sparse rows: one fused contiguous gather + bounded scatter-add
         # into [2 (base/delta), T, D] — lane count is the real run size
         lane = jnp.arange(lsp, dtype=jnp.int32)
@@ -1420,20 +1653,39 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
                          axis=0)
         neg_ok = ~jnp.any(jnp.where(negative[:, None], present, False),
                           axis=0)
-        alive = (req_ok & neg_ok & presence_table_ok(present, table)
+        # the truth-table gate is a [D]-wide gather from a 1024-entry
+        # table — ~140 ms/wave at B=64 (scalar gather) — and non-
+        # boolean queries carry the all-true table, so the lookup is
+        # compiled out unless the wave really holds boolean queries
+        tok = presence_table_ok(present, table) if use_table else True
+        alive = (req_ok & neg_ok & tok
                  & (jnp.arange(D) < n_docs_total))
         m1 = present & sc[:, None]
-        min_single_ub = jnp.min(jnp.where(m1, ubw, big), axis=0)
-        min_pair_ub = jnp.full((D,), big)
-        any_pair = jnp.zeros((D,), bool)
+        ubw_m = jnp.where(m1, ubw, big)
+        min_single_ub = jnp.min(ubw_m, axis=0)
         from .scorer import MAX_PAIR_SPAN
-        for i in range(T):
-            for j in range(i + 1, min(i + 1 + MAX_PAIR_SPAN, T)):
-                ok = present[i] & present[j] & sc[i] & sc[j]
-                pu = jnp.sqrt(ubw[i] * ubw[j])
-                min_pair_ub = jnp.where(ok, jnp.minimum(min_pair_ub, pu),
-                                        min_pair_ub)
-                any_pair = any_pair | ok
+        if T <= MAX_PAIR_SPAN + 1:
+            # every pair is within the span, so the pair-bound min has
+            # a closed form: min over pairs of √(a_i·a_j) = √(min1·min2)
+            # over the two smallest present scored bounds — O(T·D)
+            # instead of the unrolled pair loop (~79 ms/wave at B=32)
+            npres = jnp.sum(m1, axis=0)                       # [D]
+            am = jnp.argmin(ubw_m, axis=0)                    # [D]
+            min2 = jnp.min(
+                jnp.where(t_ax[:, None] == am[None, :], big, ubw_m),
+                axis=0)
+            min_pair_ub = jnp.sqrt(min_single_ub * min2)
+            any_pair = npres >= 2
+        else:
+            min_pair_ub = jnp.full((D,), big)
+            any_pair = jnp.zeros((D,), bool)
+            for i in range(T):
+                for j in range(i + 1, min(i + 1 + MAX_PAIR_SPAN, T)):
+                    ok = present[i] & present[j] & sc[i] & sc[j]
+                    pu = jnp.sqrt(ubw[i] * ubw[j])
+                    min_pair_ub = jnp.where(
+                        ok, jnp.minimum(min_pair_ub, pu), min_pair_ub)
+                    any_pair = any_pair | ok
         ubmin = jnp.minimum(jnp.where(any_pair, min_pair_ub, big),
                             min_single_ub)
         # per-doc filter-only fallback (mirrors scorer.min_scores)
@@ -1449,11 +1701,26 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
         # SAME lossless escalation check
         cval, cand, ub_missed = _block_topn(ubfinal, kappa)
 
+        # phase 2 scores only the top-k2 BY BOUND: the (k2+1)-th-best
+        # bound folds into the missed-max, so an unscored candidate
+        # that could have ranked triggers the same lossless escalation.
+        # Phase-2 gather cost is ∝ rows·P·κ·B (the dominant wave cost
+        # at ~13-56 Melem/s scalar gather), so κ=2048 rungs score 128
+        # candidates, not 2048 — the selection rung and the scoring
+        # width decouple
+        kap2 = kappa
+        if k2 < kappa:
+            vals, idxs = jax.lax.top_k(cval, k2 + 1)
+            cand = cand[idxs[:k2]]
+            cval = vals[:k2]
+            ub_missed = jnp.maximum(ub_missed, vals[k2])
+            kap2 = k2
+
         # ---- phase 2: exact scoring of the κ candidates ----
         dead_c = d_dead[cand]                                 # [κ]
         p_ax = jnp.arange(P, dtype=jnp.int32)[:, None]        # [P, 1]
-        cube = jnp.zeros((T, P, kappa), jnp.uint32)
-        pv = jnp.zeros((T, P, kappa), bool)
+        cube = jnp.zeros((T, P, kap2), jnp.uint32)
+        pv = jnp.zeros((T, P, kap2), bool)
 
         def add_row(cube, pv, rsp_c, group, base, quota, syn, is_base):
             rs = (rsp_c >> _RS_SHIFT).astype(jnp.int32)       # [κ]
@@ -1486,7 +1753,9 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
                           axis=0)
         neg_ok2 = ~jnp.any(jnp.where(negative[:, None], present2, False),
                            axis=0)
-        match2 = (req_ok2 & neg_ok2 & presence_table_ok(present2, table)
+        tok2 = presence_table_ok(present2, table) if use_table \
+            else True
+        match2 = (req_ok2 & neg_ok2 & tok2
                   & (cval > 0.0) & (min_sc < big))
         final = jnp.where(
             match2,
@@ -1503,19 +1772,21 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
             jax.lax.bitcast_convert_type(ts, jnp.uint32),
         ])
 
-    return jax.vmap(one)(d_slot, d_group, d_base, d_quota, d_syn,
-                         s_start, s_len, s_group, s_base, s_quota, s_syn,
-                         s_isbase, freqw, required, negative, scored,
-                         counts, table, qlang)
+    return jax.vmap(one)(ubb_mm, d_slot, d_group, d_base, d_quota,
+                         d_syn, s_start, s_len, s_group, s_base,
+                         s_quota, s_syn, s_isbase, freqw, required,
+                         negative, scored, counts, table, qlang)
 
 
-@partial(jax.jit, static_argnames=("n_positions", "lpost", "k2", "n_sel"))
+@partial(jax.jit, static_argnames=("n_positions", "lpost", "k2", "n_sel",
+                                   "use_table"))
 def _full_cube(d_payload, d_pdoc, d_pocc, d_cube, d_dense_rsp,
                d_siterank, d_doclang, d_dead, n_docs_total,
                c_slot, c_dslot, c_group, c_base, c_quota, c_syn,
                p_start, p_len, p_group, p_base, p_quota, p_syn, p_isbase,
                freqw, required, negative, scored, counts, table, qlang,
-               n_positions: int, lpost: int, k2: int, n_sel: int):
+               n_positions: int, lpost: int, k2: int, n_sel: int,
+               use_table: bool = True):
     """Full-corpus exact kernel (F2) for corpus-wide drivers.
 
     Builds the [T, P, D] position cube over the WHOLE doc axis — the
@@ -1554,10 +1825,16 @@ def _full_cube(d_payload, d_pdoc, d_pocc, d_cube, d_dense_rsp,
             cnt = (jax.lax.dynamic_slice(
                 d_dense_rsp, (jnp.clip(c_dslot[r], 0, V - 1) * D,),
                 (D,)) & _CNT_MASK)
-            # shift the row to the sublist's slot range [base, base+quota)
-            # — occurrence q of the term lands in cube slot base+q
+            # shift the row to the sublist's slot range [base,
+            # base+quota): out[p] = row[p - base]. Done as a contiguous
+            # dynamic_slice on a zero-padded [2P, D] image — a traced-
+            # index take here lowers to a ~P·D scalar gather per row
+            # per lane, measured as THE dominant F2 cost (~270 ms/wave)
             q = p_ax[:, 0] - c_base[r]                    # [P]
-            row = jnp.take(row, jnp.clip(q, 0, P - 1), axis=0)
+            padded = jnp.concatenate(
+                [jnp.zeros((P, D), row.dtype), row], axis=0)
+            row = jax.lax.dynamic_slice(
+                padded, (P - jnp.clip(c_base[r], 0, P), 0), (P, D))
             pvr = ((q[:, None] >= 0)
                    & (q[:, None]
                       < jnp.minimum(cnt, c_quota[r])[None, :])
@@ -1593,7 +1870,8 @@ def _full_cube(d_payload, d_pdoc, d_pocc, d_cube, d_dense_rsp,
                          axis=0)
         neg_ok = ~jnp.any(jnp.where(negative[:, None], present, False),
                           axis=0)
-        match = (req_ok & neg_ok & presence_table_ok(present, table)
+        tok = presence_table_ok(present, table) if use_table else True
+        match = (req_ok & neg_ok & tok
                  & (jnp.arange(D) < n_docs_total) & (min_sc < big))
         final = jnp.where(
             match, min_sc * final_multipliers(d_siterank, d_doclang,
@@ -1617,3 +1895,104 @@ def _full_cube(d_payload, d_pdoc, d_pocc, d_cube, d_dense_rsp,
                          c_syn, p_start, p_len, p_group, p_base, p_quota,
                          p_syn, p_isbase, freqw, required, negative,
                          scored, counts, table, qlang)
+
+
+@partial(jax.jit, static_argnames=("n_positions", "lpost", "k2",
+                                   "n_sel", "use_table"))
+def _direct_cube(d_cube, d_payload, d_pdoc, d_pocc, d_siterank,
+                 d_doclang, d_dead, n_docs_total, g_quarter, g_qsyn,
+                 p_start, p_len, p_group, p_base, p_quota, p_syn,
+                 p_isbase,
+                 freqw, required, negative, scored, counts, table, qlang,
+                 n_positions: int, lpost: int, k2: int, n_sel: int,
+                 use_table: bool = True):
+    """Direct full-corpus kernel (FD) — the F2 fast path for queries
+    whose every group assembles from quarter-aligned base cube rows
+    (1 sublist = full row; original+bigram = half+half;
+    original+synonym+bigram = half+quarter+quarter — the slot_plan
+    layouts).
+
+    No per-query [T, P, D] cube is scattered together from per-lane
+    dynamic slices, traced shifts and masked adds (measured as the
+    dominant F2 cost at ~24 ms/query): the group planes are quarter-row
+    gathers from the resident cube (quarter q of a term's [P, D] row
+    holds its occurrences q·P/4..), with a zero payload marking an
+    empty slot (real postings always carry densityrank ≥ 1, so
+    payload ≠ 0 — a build-side invariant; the cube's last slot is kept
+    all-zero as the absent-quarter target). Small non-cube sublists
+    (bigrams, deltas) add through a BOUNDED posting-scatter tail —
+    the same scatter the generic F2 runs, capped by the planner at
+    FD_SCATTER_MAX_LANES. Scoring is the very same ``min_scores``
+    every other path runs, so parity is bit-for-bit by construction.
+    Output format matches _full_cube."""
+    D = d_dead.shape[0]
+    P = n_positions
+    P4 = P // 4
+    N = d_payload.shape[0]
+    Vc = d_cube.shape[0] // (P * D)
+    big = jnp.float32(9.99e8)
+    quarter_rows = d_cube.reshape(Vc * 4, P4 * D)
+
+    def one(g_quarter, g_qsyn, p_start, p_len, p_group, p_base,
+            p_quota, p_syn, p_isbase, freqw, required, negative,
+            scored, counts, table, qlang):
+        T = required.shape[0]
+        live = ~d_dead
+        sc = counts
+        rows = quarter_rows[
+            jnp.clip(g_quarter, 0, Vc * 4 - 1)].reshape(T, 4, P4, D)
+        synbit = (g_qsyn.astype(jnp.uint32)
+                  << jnp.uint32(31))[:, :, None, None]
+        rows = jnp.where(rows != 0, rows | synbit, rows)
+        rows = rows.reshape(T, P, D)
+        pvr = (rows != 0) & live[None, None, :]               # [T, P, D]
+        # dead docs' base values must not pollute scatter-adds below
+        cube = jnp.where(pvr, rows, jnp.uint32(0))
+        # posting-granular scatter tail (bigrams, deltas, small terms —
+        # same semantics as _full_cube's scatter block)
+        lane = jnp.arange(lpost, dtype=jnp.int32)
+        idx = p_start[:, None] + lane[None, :]                # [Rp, Lp]
+        m = lane[None, :] < p_len[:, None]
+        idxc = jnp.clip(idx, 0, N - 1)
+        doc = d_pdoc[idxc]
+        occ = d_pocc[idxc].astype(jnp.int32)
+        pay = (d_payload[idxc]
+               | (p_syn[:, None].astype(jnp.uint32) << jnp.uint32(31)))
+        dead_l = d_dead[jnp.clip(doc, 0, D - 1)]
+        ok = (m & (occ < p_quota[:, None])
+              & ~(dead_l & p_isbase[:, None]))
+        slot = p_base[:, None] + occ
+        tgt = jnp.where(ok, (p_group[:, None] * P + slot) * D + doc,
+                        T * P * D)
+        cube = cube.reshape(-1).at[tgt.ravel()].add(
+            jnp.where(ok, pay, jnp.uint32(0)).ravel(), mode="drop"
+        ).reshape(T, P, D)
+        pvr = pvr.reshape(-1).at[tgt.ravel()].set(
+            ok.ravel(), mode="drop").reshape(T, P, D)
+        min_sc, present = min_scores(cube, pvr, freqw, sc)
+        req_ok = jnp.all(jnp.where(required[:, None], present, True),
+                         axis=0)
+        neg_ok = ~jnp.any(jnp.where(negative[:, None], present, False),
+                          axis=0)
+        tok = presence_table_ok(present, table) if use_table else True
+        match = (req_ok & neg_ok & tok
+                 & (jnp.arange(D) < n_docs_total) & (min_sc < big))
+        final = jnp.where(
+            match, min_sc * final_multipliers(d_siterank, d_doclang,
+                                              qlang), 0.0)
+        nm = jnp.sum(match)
+        w_vals, w_idx, missed = _block_topn(final, min(n_sel, D))
+        ts, tl = jax.lax.top_k(w_vals, min(k2, n_sel, D))
+        ti = w_idx[tl]
+        return jnp.concatenate([
+            jnp.atleast_1d(nm.astype(jnp.uint32)),
+            jax.lax.bitcast_convert_type(jnp.atleast_1d(missed),
+                                         jnp.uint32),
+            ti.astype(jnp.uint32),
+            jax.lax.bitcast_convert_type(ts, jnp.uint32),
+        ])
+
+    return jax.vmap(one)(g_quarter, g_qsyn, p_start, p_len, p_group,
+                         p_base, p_quota, p_syn, p_isbase, freqw,
+                         required, negative, scored, counts, table,
+                         qlang)

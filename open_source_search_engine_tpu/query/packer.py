@@ -338,7 +338,14 @@ def pack_pass(prep: PreparedQuery, doc_offset: int = 0,
         # runs are contiguous: stable docid sort keeps sublist-major
         # order within a doc.
         if len(didx):
-            sp = plan.groups[g_i].slot_plan(max_positions)
+            # quota only over sublists with postings (absent synonyms
+            # must not reserve dead slots) — same mask the device
+            # planner derives from its druns, so parity holds
+            n_subs = len(plan.groups[g_i].sublists)
+            have = np.zeros(n_subs, bool)
+            have[np.unique(gl.sub)] = True
+            sp = plan.groups[g_i].slot_plan(max_positions,
+                                            present=list(have))
             bases = np.array([b for b, _ in sp], np.int32)
             quotas = np.array([q for _, q in sp], np.int32)
             n = len(didx)

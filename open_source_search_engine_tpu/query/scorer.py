@@ -135,6 +135,37 @@ def position_weights(cube, pvalid):
     return posscore, posw, wordpos, hg
 
 
+def pair_best(posw_i, wordpos_i, in_body_i, pv_i,
+              posw_j, wordpos_j, in_body_j, pv_j):
+    """Best pair placement for one term pair: max over the P×P position
+    cross product of BASE·posw_i·posw_j/(dist+1) with the reference's
+    distance semantics (getTermPairScoreForWindow/NonBody unified —
+    module docstring). Inputs are per-side [P, ...] arrays with an
+    arbitrary minor doc axis; returns the max over both P axes.
+
+    The single definition of the pair math — min_scores and the
+    direct-cube kernel both call it, so path parity holds by
+    construction."""
+    delta = (wordpos_j[None, :, :]
+             - wordpos_i[:, None, :]).astype(jnp.float32)
+    d_plain = jnp.maximum(jnp.abs(delta), 2.0)         # [P, P, D]
+    body_i = in_body_i[:, None, :]
+    body_j = in_body_j[None, :, :]
+    mixed = body_i != body_j
+    both_nb = (~body_i) & (~body_j)
+    d_base = jnp.where(
+        both_nb & (d_plain > weights.NONBODY_DIST_CAP),
+        float(weights.FIXED_DISTANCE), d_plain)
+    d_adj = (jnp.where(d_base >= QDIST, d_base - QDIST, d_base)
+             + (delta < 0))
+    dist = jnp.where(mixed, float(weights.FIXED_DISTANCE), d_adj)
+    pv = (pv_i[:, None, :] & pv_j[None, :, :])
+    ps = (weights.BASE_SCORE
+          * posw_i[:, None, :] * posw_j[None, :, :]
+          / (dist + 1.0)) * pv
+    return jnp.max(ps, axis=(0, 1))                    # [D]
+
+
 def min_scores(cube, pvalid, freq_weight, single_counts):
     """The docIdLoop scoring core on a [T, P, D] cube: returns
     (min_score [D] before multipliers, present [T, D]).
@@ -177,24 +208,8 @@ def min_scores(cube, pvalid, freq_weight, single_counts):
     any_pair = jnp.zeros((D,), jnp.bool_)
     for i in range(T):
         for j in range(i + 1, min(i + 1 + MAX_PAIR_SPAN, T)):
-            delta = (wordpos[j][None, :, :]
-                     - wordpos[i][:, None, :]).astype(jnp.float32)
-            d_plain = jnp.maximum(jnp.abs(delta), 2.0)     # [P, P, D]
-            body_i = in_body[i][:, None, :]
-            body_j = in_body[j][None, :, :]
-            mixed = body_i != body_j
-            both_nb = (~body_i) & (~body_j)
-            d_base = jnp.where(
-                both_nb & (d_plain > weights.NONBODY_DIST_CAP),
-                float(weights.FIXED_DISTANCE), d_plain)
-            d_adj = (jnp.where(d_base >= QDIST, d_base - QDIST, d_base)
-                     + (delta < 0))
-            dist = jnp.where(mixed, float(weights.FIXED_DISTANCE), d_adj)
-            pv = (pvalid[i][:, None, :] & pvalid[j][None, :, :])
-            ps = (weights.BASE_SCORE
-                  * posw[i][:, None, :] * posw[j][None, :, :]
-                  / (dist + 1.0)) * pv
-            best = jnp.max(ps, axis=(0, 1))                # [D]
+            best = pair_best(posw[i], wordpos[i], in_body[i], pvalid[i],
+                             posw[j], wordpos[j], in_body[j], pvalid[j])
             wts = best * freq_weight[i] * freq_weight[j]
             pair_ok = (present[i] & present[j]
                        & single_counts[i] & single_counts[j])

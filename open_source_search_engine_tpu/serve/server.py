@@ -49,7 +49,7 @@ class QueryBatcher:
     same-parameter batches of ≤ MAX_B. Errors propagate to every waiter
     of the failing batch."""
 
-    MAX_B = 32
+    MAX_B = 64
     WINDOW_S = 0.002  # brief collect window once a first query arrives
 
     def __init__(self, run_batch):
@@ -58,6 +58,11 @@ class QueryBatcher:
         self._cv = threading.Condition()
         self._queue: list[tuple[tuple, str, dict]] = []
         self._alive = True
+        # two executors so batch N's host post-processing (titledb
+        # reads, clustering) overlaps batch N+1's device waves
+        # (device_get releases the GIL)
+        from concurrent.futures import ThreadPoolExecutor
+        self._pool = ThreadPoolExecutor(2)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="query-batcher")
         self._thread.start()
@@ -75,6 +80,7 @@ class QueryBatcher:
                 e[2]["err"] = RuntimeError("query batcher stopped")
             self._queue.clear()
             self._cv.notify_all()
+        self._pool.shutdown(wait=False)
 
     def search(self, key: tuple, q: str, timeout: float = 60.0):
         holder: dict = {}
@@ -107,16 +113,26 @@ class QueryBatcher:
                 for e in batch:
                     self._queue.remove(e)
             try:
-                res = self._run_batch(key, [e[1] for e in batch])
-                with self._cv:
-                    for e, r in zip(batch, res):
-                        e[2]["res"] = r
-                    self._cv.notify_all()
-            except Exception as exc:  # noqa: BLE001 — waiters must wake
+                self._pool.submit(self._run_one, key, batch)
+            except RuntimeError as exc:  # pool shut down by stop()
                 with self._cv:
                     for e in batch:
                         e[2]["err"] = exc
                     self._cv.notify_all()
+                return
+
+    def _run_one(self, key, batch) -> None:
+        try:
+            res = self._run_batch(key, [e[1] for e in batch])
+            with self._cv:
+                for e, r in zip(batch, res):
+                    e[2]["res"] = r
+                self._cv.notify_all()
+        except Exception as exc:  # noqa: BLE001 — waiters must wake
+            with self._cv:
+                for e in batch:
+                    e[2]["err"] = exc
+                self._cv.notify_all()
 
 
 def _xml_escape(s: str) -> str:

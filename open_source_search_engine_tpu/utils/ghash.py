@@ -23,10 +23,27 @@ TERMID_BITS = 48
 TERMID_MASK = (1 << TERMID_BITS) - 1
 
 
+#: byte-at-a-time FNV above this length costs ~1.5 µs/KB in Python;
+#: long payloads (page content, joined section text) take the C-speed
+#: blake2b path instead. The threshold sits at 1 KiB so every KEY
+#: derived from a URL (docids, titledb/spiderdb/linkdb keys — URLs are
+#: well under 1 KiB after normalization caps) keeps its historical
+#: value; only content/section hashes of large payloads changed, which
+#: affects cross-version dedup votes, not record reachability.
+_LONG_DATA = 1024
+
+
 def hash64(data: bytes | str, seed: int = 0) -> int:
-    """FNV-1a 64-bit with a murmur-style finalizer."""
+    """64-bit content hash: FNV-1a + murmur finalizer for short keys
+    (words, urls), blake2b for long payloads."""
     if isinstance(data, str):
         data = data.encode("utf-8")
+    if len(data) > _LONG_DATA:
+        import hashlib
+        h = hashlib.blake2b(data, digest_size=8,
+                            key=seed.to_bytes(8, "little") if seed
+                            else b"").digest()
+        return int.from_bytes(h, "little")
     h = (_FNV_OFFSET ^ seed) & _MASK64
     for b in data:
         h ^= b

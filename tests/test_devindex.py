@@ -318,6 +318,53 @@ class TestFullCubePath:
         assert len(di.cube_slot_of) > 0  # cube rows materialized
 
 
+    def test_fd_direct_route_parity(self, tmp_path, monkeypatch):
+        """The direct-cube (FD) kernel: all-cube-term queries skip cube
+        assembly; results must match the host path exactly, and the
+        route must actually be taken (direct_ok) until delta postings
+        disqualify it."""
+        import open_source_search_engine_tpu.query.devindex as dv
+        from open_source_search_engine_tpu.query.compiler import \
+            compile_query
+
+        monkeypatch.setattr(dv, "DENSE_MIN_DF", 0)
+        monkeypatch.setattr(dv, "CUBE_MIN_DF", 16)
+        c = Collection("fd", tmp_path)
+        c.conf.pqr_enabled = False
+        for i in range(200):
+            extra = "orange grove" if i % 3 == 0 else "plain field"
+            docproc.index_document(
+                c, f"http://fd.test/s{i % 7}/d{i}",
+                f"<html><head><title>Doc {i} common</title></head><body>"
+                f"<p>common words everywhere {extra} number{i}.</p>"
+                "</body></html>")
+        c.posdb.dump()
+        di = get_device_index(c)
+        queries = ["common", "common words", "words everywhere common"]
+        for q in queries:
+            p = di.plan(compile_query(q))
+            assert p.direct_ok, q  # base-only cube terms -> FD route
+            host = engine.search(c, q, topk=10, site_cluster=False,
+                                 with_snippets=False)
+            dev = search_device(c, q, topk=10, site_cluster=False,
+                                with_snippets=False)
+            assert_parity(host, dev, q)
+        # delta postings ride the FD scatter tail (still direct);
+        # parity must hold through it
+        docproc.index_document(
+            c, "http://fd.test/fresh",
+            "<html><head><title>Fresh common</title></head><body>"
+            "<p>common arrival.</p></body></html>")
+        di.refresh()
+        p = di.plan(compile_query("common"))
+        assert p.direct_ok and len(p.p_start)  # delta -> scatter rows
+        host = engine.search(c, "common", topk=10, site_cluster=False,
+                             with_snippets=False)
+        dev = search_device(c, "common", topk=10, site_cluster=False,
+                            with_snippets=False)
+        assert_parity(host, dev, "common")
+
+
 class TestClusterdbRead:
     """Query-time clusterdb use (Clusterdb.h:42, Msg51.h:96): the
     sitehash column clusters results BEFORE any titledb access."""

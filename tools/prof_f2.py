@@ -166,7 +166,7 @@ def f2_staged(d_payload, d_pdoc, d_pocc, d_cube, d_dense_rsp,
 
 
 def main():
-    coll = Collection("bench", "/root/bench_corpus")
+    coll = Collection("bench", os.environ.get("BENCH_DIR", "/root/bench_cache/b100k"))
     di = engine.get_device_index(coll)
     print("ready", flush=True)
     qs = bench._make_queries(3000, seed=33)

@@ -163,7 +163,7 @@ def f1_staged(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
 
 
 def main():
-    coll = Collection("bench", "/root/bench_corpus")
+    coll = Collection("bench", os.environ.get("BENCH_DIR", "/root/bench_cache/b100k"))
     di = engine.get_device_index(coll)
     print(f"ready D={di.D_cap}", flush=True)
     qs = bench._make_queries(3000, seed=11)
