@@ -19,6 +19,14 @@ Honesty notes:
 
 Prints exactly ONE JSON line:
 ``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}``.
+
+Scale line (BENCH_DOCS=250000 — 62.9M stored postings, 94% of the
+2^26 per-shard posting cap, the "split across shards" design point):
+measured 8.0 qps, p50 392 ms on one v5e chip (2026-07-30; the
+full-corpus exact kernels are O(D) per query, so per-query cost grows
+with the shard and the HBM budget shrinks wave batching — the
+multi-shard mesh, not a bigger shard, is the scaling axis, exactly as
+the reference splits at ~500k pages per host).
 """
 
 from __future__ import annotations
@@ -75,6 +83,54 @@ def _make_queries(n: int, seed: int):
     return out
 
 
+def main_mesh(n_shards: int) -> None:
+    """Multi-chip mode (BENCH_MESH=N): the resident kernel sharded over
+    an N-device mesh — one DeviceIndex per shard pinned per device,
+    cluster-wide term stats, Msg3a merge. With one physical TPU on this
+    machine it runs on N virtual CPU devices: a CORRECTNESS/SCALING
+    exercise of the production multi-chip path, not a TPU perf number
+    (the JSON line says so)."""
+    import os as _os
+    flags = _os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        _os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n_shards}")
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+    from open_source_search_engine_tpu.parallel.sharded import (
+        MeshResident, ShardedCollection)
+
+    bdir = os.environ.get("BENCH_DIR") or tempfile.mkdtemp(
+        prefix="osse_bench_mesh_")
+    n_docs = int(os.environ.get("BENCH_DOCS", "5000"))
+    sc = ShardedCollection("bench", bdir, n_shards=n_shards)
+    if sc.num_docs < n_docs:
+        for url, html in _gen_docs(n_docs):
+            sc.index_document(url, html)
+        for shard in sc.shards:
+            shard.posdb.dump()
+            shard.titledb.dump()
+            shard.save()
+    mr = MeshResident(sc)
+    qs = _make_queries(96, seed=7)
+    for q in qs[:16]:
+        mr.search(q, topk=10, with_snippets=False)  # compile warm
+    t0 = time.perf_counter()
+    for a in range(16, len(qs), 16):
+        mr.search_batch(qs[a:a + 16], topk=10, with_snippets=False)
+    elapsed = time.perf_counter() - t0
+    qps = (len(qs) - 16) / elapsed
+    print(json.dumps({
+        "metric": "queries_per_sec_mesh_cpu_validation",
+        "value": round(qps, 2), "unit": "qps",
+        "vs_baseline": 0.0, "n_shards": n_shards, "docs": n_docs,
+    }))
+
+
 def main() -> None:
     import jax
 
@@ -98,12 +154,20 @@ def main() -> None:
     t0 = time.perf_counter()
     built = coll.num_docs < N_DOCS  # corpus build actually runs
     if built:
-        for i, (url, html) in enumerate(_gen_docs(N_DOCS)):
-            docproc.index_document(coll, url, html)
-            if (i + 1) % 20000 == 0:
-                print(f"# indexed {i + 1}/{N_DOCS} "
-                      f"({(i + 1) / (time.perf_counter() - t0):.0f} "
-                      "docs/s)", file=sys.stderr)
+        chunk: list = []
+        done = 0
+        for url, html in _gen_docs(N_DOCS):
+            chunk.append((url, html))
+            if len(chunk) >= 512:
+                docproc.index_batch(coll, chunk)
+                done += len(chunk)
+                chunk = []
+                if done % 20480 == 0:
+                    print(f"# indexed {done}/{N_DOCS} "
+                          f"({done / (time.perf_counter() - t0):.0f} "
+                          "docs/s)", file=sys.stderr)
+        if chunk:
+            docproc.index_batch(coll, chunk)
         # dump → the measured path serves from the on-disk base (dense +
         # cube rows built); the remaining delta stays empty
         coll.posdb.dump()
@@ -222,4 +286,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_MESH"):
+        main_mesh(int(os.environ["BENCH_MESH"]))
+    else:
+        main()

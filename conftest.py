@@ -21,3 +21,9 @@ jax.config.update("jax_platforms", "cpu")
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running multi-process integration test")
+    # never resolve real DNS from tests: the sandbox's resolver path can
+    # hang, and every distinct host would pay the lookup timeout. The
+    # deterministic pseudo-IP keeps per-IP politeness/sharding semantics
+    # exercised (same host → same IP) without the network.
+    from open_source_search_engine_tpu.utils import ipresolve
+    ipresolve.resolver_override = ipresolve._pseudo_ip

@@ -77,6 +77,12 @@ class MetaList:
     #: after the site's section votes move
     sections: list = field(default_factory=list)
     boiler_sections: list = field(default_factory=list)
+    #: structured document fields (qajson-style): every extracted field
+    #: (strings included — facet source) plus fielddb records for the
+    #: numeric subset and the built-in ``date``
+    fields: dict = field(default_factory=dict)
+    fielddb_keys: np.ndarray | None = None
+    fielddb_blobs: list = field(default_factory=list)
 
 
 def doc_section_hashes(tdoc: TokenizedDoc) -> dict[int, int]:
@@ -85,9 +91,9 @@ def doc_section_hashes(tdoc: TokenizedDoc) -> dict[int, int]:
     container's word content."""
     from ..index.sectiondb import MIN_SECTION_WORDS
     by_sid: dict[int, list[str]] = {}
-    for t in tdoc.tokens:
-        if t.section_id:
-            by_sid.setdefault(t.section_id, []).append(t.word)
+    for sid, w in zip(tdoc.section_ids, tdoc.words):
+        if sid:
+            by_sid.setdefault(sid, []).append(w)
     return {sid: ghash.hash64(" ".join(ws)) & 0xFFFFFFFF
             for sid, ws in by_sid.items()
             if len(ws) >= MIN_SECTION_WORDS}
@@ -126,12 +132,76 @@ def _spam_ranks(words: list[str]) -> np.ndarray:
     ranks = np.full(n, posdb.MAXWORDSPAMRANK, dtype=np.uint64)
     if n < 40:
         return ranks
-    counts = Counter(words)
-    for i, w in enumerate(words):
-        frac = counts[w] / n
-        if frac > 0.125:
-            ranks[i] = max(2, int(posdb.MAXWORDSPAMRANK * (1.0 - frac) * 0.8))
-    return ranks
+    uniq, inv, counts = np.unique(np.asarray(words, dtype=object),
+                                  return_inverse=True,
+                                  return_counts=True)
+    frac = counts[inv] / n
+    docked = np.maximum(
+        2, (posdb.MAXWORDSPAMRANK * (1.0 - frac) * 0.8).astype(np.int64)
+    ).astype(np.uint64)
+    return np.where(frac > 0.125, docked, ranks)
+
+
+def extract_fields(content: str, tdoc=None,
+                   is_html: bool = True) -> dict:
+    """Structured document fields (the qajson/qaxml ingestion path,
+    ``qa.cpp:2910``): a JSON document's scalars become fields (nested
+    objects flatten with dots). Strings feed facets; numbers feed
+    fielddb columns (gbmin/gbmax/gbsortby). HTML documents contribute
+    only the built-in ``date`` field, taken from the page's date
+    ``<meta>`` tags (``tdoc.meta_date``) in ``build_meta_list``."""
+    import json as _json
+    fields: dict = {}
+    stripped = content.lstrip()
+    if stripped.startswith("{"):
+        try:
+            obj = _json.loads(stripped)
+        except ValueError:
+            obj = None
+        if isinstance(obj, dict):
+            def flat(prefix, o):
+                for k, v in o.items():
+                    key = f"{prefix}{k}" if not prefix else \
+                        f"{prefix}.{k}"
+                    if isinstance(v, dict):
+                        flat(key, v)
+                    elif isinstance(v, (int, float, str)) \
+                            and not isinstance(v, bool):
+                        fields[key.lower()] = v
+            flat("", obj)
+    return fields
+
+
+def _parse_date(val) -> float | None:
+    """Best-effort document date → epoch seconds (meta tags carry
+    ISO-8601 mostly)."""
+    if isinstance(val, (int, float)):
+        return float(val)
+    if not isinstance(val, str) or not val:
+        return None
+    from datetime import datetime, timezone
+    for fmt in ("%Y-%m-%dT%H:%M:%S", "%Y-%m-%d %H:%M:%S", "%Y-%m-%d",
+                "%Y/%m/%d"):
+        try:
+            dt = datetime.strptime(val[:19], fmt)
+            return dt.replace(tzinfo=timezone.utc).timestamp()
+        except ValueError:
+            continue
+    return None
+
+
+def _tokenize_doc(content: str, url: str, is_html: bool,
+                  fields: dict | None) -> TokenizedDoc:
+    """Structured (JSON) docs tokenize their string field values as the
+    searchable text; everything else goes through the HTML/plain
+    tokenizers."""
+    if fields:
+        text = " . ".join(str(v) for v in fields.values()
+                          if isinstance(v, str))
+        if text:
+            return tokenize_text(text)
+    return (tokenize_html(content, url) if is_html
+            else tokenize_text(content))
 
 
 def build_meta_list(
@@ -149,6 +219,8 @@ def build_meta_list(
     linkee_sites: dict | None = None,
     tdoc: TokenizedDoc | None = None,
     boiler_sections: list | None = None,
+    sect_of: dict[int, int] | None = None,
+    fields: dict | None = None,
 ) -> MetaList:
     """Compute every record one document contributes. ``delete=True``
     produces the same records as tombstones (reference: the old doc's
@@ -171,24 +243,26 @@ def build_meta_list(
     u = normalize(url)
     site = site or u.site
     docid = ghash.doc_id(u.full)
+    if fields is None:
+        fields = extract_fields(content, is_html=is_html)
     if tdoc is None:
-        tdoc = (tokenize_html(content, u.full) if is_html
-                else tokenize_text(content))
+        tdoc = _tokenize_doc(content, u.full, is_html, fields)
     edges = resolve_links(tdoc.links, u.full)
     if linkee_sites is None:
         resolver = site_resolver or (lambda lu: lu.site)
         linkee_sites = {lk.full: resolver(lk) for lk, _ in edges}
-    sect_of = doc_section_hashes(tdoc)
+    if sect_of is None:
+        sect_of = doc_section_hashes(tdoc)
     boiler = set(boiler_sections or [])
 
-    doc_words = [t.word for t in tdoc.tokens]
+    doc_words = list(tdoc.words)
     words = list(doc_words)
-    wp_list = [t.wordpos for t in tdoc.tokens]
-    hg_list = [t.hashgroup for t in tdoc.tokens]
-    sent_list = [t.sentence_id for t in tdoc.tokens]
+    wp_list = list(tdoc.wordpos)
+    hg_list = list(tdoc.hashgroups)
+    sent_list = list(tdoc.sentence_ids)
 
     if langid is None:
-        langid = detect_language(doc_words)
+        langid = detect_language(doc_words, text=tdoc.text)
 
     # inlink anchor tokens: each anchor is its own sentence, in its own
     # position neighborhood (gaps > NONBODY_DIST_CAP=50 so words of
@@ -225,8 +299,8 @@ def build_meta_list(
             # the site get their spam rank docked
             from ..index.sectiondb import BOILER_SPAMRANK
             bmask = np.array(
-                [sect_of.get(t.section_id) in boiler
-                 for t in tdoc.tokens], dtype=bool)
+                [sect_of.get(sid) in boiler
+                 for sid in tdoc.section_ids], dtype=bool)
             doc_spam = np.where(bmask,
                                 np.minimum(doc_spam, BOILER_SPAMRANK),
                                 doc_spam)
@@ -279,6 +353,24 @@ def build_meta_list(
     posdb_keys = np.concatenate([posdb_keys, extra_terms]) if len(posdb_keys) \
         else extra_terms
 
+    # structured fields: resolve the built-in date ONCE and store the
+    # resolved dict in the titlerec, so the tombstone path regenerates
+    # byte-identical fielddb records (same resolution the posdb
+    # tombstones rely on)
+    fields = dict(fields)
+    dv = _parse_date(fields.get("date"))
+    if dv is None:
+        # HTML pages: the date <meta> tag (article:published_time etc.)
+        dv = _parse_date(getattr(tdoc, "meta_date", "") or None)
+    fields["date"] = dv if dv is not None else float(
+        ts if ts is not None else time.time())
+    from ..index import fielddb as fielddb_mod
+    numeric = {f: v for f, v in fields.items()
+               if isinstance(v, (int, float))
+               and not isinstance(v, bool)}
+    fdb_keys, fdb_blobs = fielddb_mod.make_records(
+        docid, numeric, delbit=0 if delete else 1)
+
     if delete:
         title_rec = b""  # tombstone payload; skip the pointless compress
     else:
@@ -292,7 +384,8 @@ def build_meta_list(
                    "inlinks": [[t, sr] for t, sr in inlinks],
                    "linkee_sites": linkee_sites,
                    "sections": sorted(set(sect_of.values())),
-                   "boiler_sections": sorted(boiler)},
+                   "boiler_sections": sorted(boiler),
+                   "fields": fields},
         )
     sitehash = ghash.hash64(site) & ((1 << clusterdb.SITEHASH_BITS) - 1)
     return MetaList(
@@ -309,6 +402,9 @@ def build_meta_list(
         edge_sites=linkee_sites,
         sections=sorted(set(sect_of.values())),
         boiler_sections=sorted(boiler),
+        fields=fields,
+        fielddb_keys=fdb_keys,
+        fielddb_blobs=fdb_blobs,
     )
 
 
@@ -426,17 +522,20 @@ def index_document(coll: Collection, url: str, content: str, *,
     inlinks = coll.linkdb.inlinks_for_url(site, u.full)
     # boilerplate gate (Sections dup votes): sections this page shares
     # with enough sibling pages of the site demote at build time
-    tdoc = (tokenize_html(content, u.full) if is_html
-            else tokenize_text(content))
-    boiler = coll.sectiondb.boiler_set(
-        site, doc_section_hashes(tdoc).values())
+    flds = extract_fields(content, is_html=is_html)
+    tdoc = _tokenize_doc(content, u.full, is_html, flds)
+    sect_of = doc_section_hashes(tdoc)
+    boiler = coll.sectiondb.boiler_set(site, sect_of.values())
     ml = build_meta_list(url, content, is_html=is_html, siterank=siterank,
                          langid=langid, inlinks=inlinks, site=site,
                          site_resolver=coll.tagdb.site_of, tdoc=tdoc,
-                         boiler_sections=boiler)
+                         boiler_sections=boiler, sect_of=sect_of,
+                         fields=flds)
     coll.posdb.add(ml.posdb_keys)
     coll.titledb.add(ml.titledb_key.reshape(1), [ml.title_rec])
     coll.clusterdb.add(ml.clusterdb_key.reshape(1))
+    if ml.fielddb_keys is not None and len(ml.fielddb_keys):
+        coll.fielddb.add(ml.fielddb_keys, ml.fielddb_blobs)
     coll.sectiondb.add_page_sections(site, u.full, ml.sections)
     coll.titlerec_cache.pop(ml.docid, None)
     if ml.words:
@@ -466,6 +565,126 @@ def index_document(coll: Collection, url: str, content: str, *,
     log.debug("indexed %s docid=%d keys=%d inlinks=%d", url, ml.docid,
               len(ml.posdb_keys), len(inlinks))
     return ml
+
+
+def index_batch(coll: Collection, docs, *, is_html: bool = True,
+                siterank: int = 0, langid: int | None = None,
+                propagate: bool = True) -> list[MetaList | None]:
+    """Bulk indexing: N documents in one pass — the TPU-era shape of
+    the reference's fully-async build pipeline (SURVEY §2.5). Same
+    records as N ``index_document`` calls, restructured into three
+    phases so per-document overheads amortize:
+
+    * **reads first** (tagdb gates, existing-doc probes, inlink
+      harvests, boilerplate votes) — no writes interleave, so the
+      memtables seal ONCE per batch instead of once per document
+      (the seal-thrash was a top indexing cost);
+    * **compute** (tokenize + meta lists) — pure, per document;
+    * **writes last**, one batched Rdb add per database: a single
+      concatenated posdb add, one titledb/clusterdb add, then linkdb
+      edges and section votes.
+
+    Documents already in the index (re-adds) and within-batch duplicate
+    URLs fall back to the sequential path — bulk loads are
+    overwhelmingly fresh URLs. Returns one MetaList (or None for
+    banned/failed docs) per input, in order."""
+    out: list[MetaList | None] = [None] * len(docs)
+    seen_urls: dict[str, int] = {}
+    leftovers: list[tuple[int, str, str]] = []  # dups/re-adds, last
+    work = []  # (i, u, url, content, site, siterank)
+    for i, (url, content) in enumerate(docs):
+        try:
+            u = normalize(url)
+        except Exception:  # noqa: BLE001 — junk URLs abound in bulk
+            continue
+        banned, site, sr_override = coll.tagdb.index_gate(u)
+        if banned:
+            remove_document(coll, url, propagate=propagate)
+            log.info("tagdb manualban: %s not indexed", url)
+            continue
+        if u.full in seen_urls or get_document(coll, url=u.full) \
+                is not None:
+            # duplicate within batch or re-add → sequential fallback,
+            # DEFERRED until after the batch's records are written:
+            # indexing it now would race phase C (the first occurrence
+            # isn't in the Rdb yet, so newest-wins would resurrect it
+            # and doc accounting would double-count)
+            leftovers.append((i, url, content))
+            continue
+        seen_urls[u.full] = i
+        work.append((i, u, url, content, site,
+                     siterank if sr_override is None else sr_override))
+
+    # --- phase A reads: inlink harvests + boilerplate votes ---
+    reads = []
+    for i, u, url, content, site, sr in work:
+        inlinks = coll.linkdb.inlinks_for_url(site, u.full)
+        flds = extract_fields(content, is_html=is_html)
+        tdoc = _tokenize_doc(content, u.full, is_html, flds)
+        sect_of = doc_section_hashes(tdoc)
+        boiler = coll.sectiondb.boiler_set(site, sect_of.values())
+        reads.append((inlinks, tdoc, boiler, sect_of, flds))
+
+    # --- phase B compute: meta lists (pure) ---
+    metas = []
+    for (i, u, url, content, site, sr), \
+            (inlinks, tdoc, boiler, sect_of, flds) in zip(work, reads):
+        ml = build_meta_list(url, content, is_html=is_html,
+                             siterank=sr, langid=langid,
+                             inlinks=inlinks, site=site,
+                             site_resolver=coll.tagdb.site_of,
+                             tdoc=tdoc, boiler_sections=boiler,
+                             sect_of=sect_of, fields=flds)
+        metas.append(ml)
+        out[i] = ml
+
+    def _run_leftovers():
+        for i, url, content in leftovers:
+            out[i] = index_document(coll, url, content,
+                                    is_html=is_html,
+                                    siterank=siterank, langid=langid,
+                                    propagate=propagate)
+
+    if not metas:
+        _run_leftovers()
+        return out
+    # --- phase C writes: ONE add per Rdb ---
+    coll.posdb.add(np.concatenate([ml.posdb_keys for ml in metas]))
+    coll.titledb.add(
+        np.concatenate([ml.titledb_key.reshape(1) for ml in metas]),
+        [ml.title_rec for ml in metas])
+    coll.clusterdb.add(
+        np.concatenate([ml.clusterdb_key.reshape(1) for ml in metas]))
+    withf = [ml for ml in metas
+             if ml.fielddb_keys is not None and len(ml.fielddb_keys)]
+    if withf:
+        coll.fielddb.add(
+            np.concatenate([ml.fielddb_keys for ml in withf]),
+            [b for ml in withf for b in ml.fielddb_blobs])
+    for (i, u, url, content, site, sr), ml in zip(work, metas):
+        coll.sectiondb.add_page_sections(site, u.full, ml.sections)
+        coll.titlerec_cache.pop(ml.docid, None)
+        if ml.words:
+            coll.speller.add_doc_words(ml.words)
+        coll.doc_added()
+        for linkee, anchor in ml.edges:
+            coll.linkdb.add_link(
+                ml.edge_sites.get(linkee.full, linkee.site), site,
+                u.full, linkee_url=linkee.full, anchor_text=anchor,
+                linker_siterank=sr)
+        ml.refresh_targets = [e[0] for e in ml.edges]
+    if propagate:
+        for (i, u, url, content, site, sr), ml in zip(work, metas):
+            if ml.refresh_targets:
+                refresh_linkees(
+                    ml.refresh_targets, site,
+                    get_doc=lambda lk: get_document(coll, url=lk.full),
+                    linkdb_of=lambda _site: coll.linkdb,
+                    reindex=lambda lk, rec: reindex_document(
+                        coll, lk.full, propagate=False),
+                    site_of=coll.tagdb.site_of)
+    _run_leftovers()
+    return out
 
 
 def reindex_document(coll: Collection, url: str, *,
@@ -501,7 +720,8 @@ def tombstone_meta_list(rec: dict) -> MetaList:
                                     rec.get("inlinks") or []],
                            site=rec.get("site"),
                            linkee_sites=rec.get("linkee_sites"),
-                           boiler_sections=rec.get("boiler_sections"))
+                           boiler_sections=rec.get("boiler_sections"),
+                           fields=rec.get("fields"))
 
 
 def remove_document(coll: Collection, url: str, _count: bool = True,
@@ -527,6 +747,8 @@ def remove_document(coll: Collection, url: str, _count: bool = True,
     coll.posdb.add(ml.posdb_keys)
     coll.titledb.add(ml.titledb_key.reshape(1), [b""])
     coll.clusterdb.add(ml.clusterdb_key.reshape(1))
+    if ml.fielddb_keys is not None and len(ml.fielddb_keys):
+        coll.fielddb.add(ml.fielddb_keys, ml.fielddb_blobs)
     coll.sectiondb.remove_page_sections(
         ml.site, u.full, rec.get("sections") or [])
     coll.titlerec_cache.pop(ml.docid, None)

@@ -69,6 +69,14 @@ class Collection:
         self.dir = Path(base_dir) / "coll" / name
         self.dir.mkdir(parents=True, exist_ok=True)
         self.conf = conf or CollectionConf(name)
+        # per-collection config persists alongside the Rdbs (reference
+        # coll.conf) — a broadcast parm survives the node's restart
+        self._conf_path = self.dir / "coll.conf"
+        if conf is None and self._conf_path.exists():
+            try:
+                self.conf.load(self._conf_path)
+            except Exception:  # noqa: BLE001 — torn write; defaults win
+                pass
         self.posdb = rdblite.Rdb("posdb", self.dir, posdb.KEY_DTYPE)
         self.titledb = rdblite.Rdb("titledb", self.dir, titledb.KEY_DTYPE,
                                    has_data=True)
@@ -80,6 +88,8 @@ class Collection:
         self.tagdb = Tagdb(self.dir)
         from .sectiondb import Sectiondb
         self.sectiondb = Sectiondb(self.dir)
+        from .fielddb import Fielddb
+        self.fielddb = Fielddb(self.dir)
         from ..query.speller import Speller
         self.speller = Speller(self.dir)
         self._stats_path = self.dir / "collstats.json"
@@ -102,7 +112,8 @@ class Collection:
         return {"posdb": self.posdb, "titledb": self.titledb,
                 "clusterdb": self.clusterdb, "linkdb": self.linkdb.rdb,
                 "tagdb": self.tagdb.rdb,
-                "sectiondb": self.sectiondb.rdb}
+                "sectiondb": self.sectiondb.rdb,
+                "fielddb": self.fielddb.rdb}
 
     # --- stats used by ranking ---
 
@@ -125,6 +136,7 @@ class Collection:
         for db in self.rdbs().values():
             db.save()
         self.speller.save()
+        self.conf.save(self._conf_path)
         self._save_stats()
 
     def dump_all(self) -> None:

@@ -1,0 +1,117 @@
+"""Fielddb — per-document numeric field values (the datedb role,
+generalized).
+
+Reference: ``Datedb.h:60`` (an indexdb clone whose score byte carries a
+date, enabling date-constrained search) and the numeric facet/range
+operators over structured documents (``gbmin:``/``gbmax:``/
+``gbsortby:``/``gbfacet:`` fielded terms, ``Query.h:209``; exercised by
+``qa.cpp:2910`` qajson). The reference encodes numbers into posting
+keys; on a TPU the natural shape is a **per-doc numeric column**: one
+dense ``[D]`` f32 per queried field, aligned to the resident doc axis,
+consumed by the kernels as a filter mask or a sort override.
+
+Storage is one Rdb: key = fieldhash32 · docid38 · delbit (newest wins,
+tombstones annihilate), payload = float64 little-endian. ``date`` is a
+built-in field (document timestamp, seconds since epoch) — ``datedb``
+is exactly ``fielddb["date"]``.
+"""
+
+from __future__ import annotations
+
+import struct
+from pathlib import Path
+
+import numpy as np
+
+from ..utils import ghash
+from . import rdblite
+
+#: key: fieldhash(32) | docid(38) | delbit(1) packed into 12 bytes
+KEY_DTYPE = np.dtype([("lo", "<u8"), ("hi", "<u4")], align=False)
+
+#: the built-in document-timestamp field (the datedb role)
+DATE_FIELD = "date"
+
+
+def field_hash(field: str) -> int:
+    return ghash.hash64(field.lower()) & 0xFFFFFFFF
+
+
+def pack_key(field: str, docid: int, delbit: int = 1) -> np.ndarray:
+    fh = field_hash(field)
+    lo = (np.uint64(docid & ((1 << 38) - 1)) << np.uint64(1)) \
+        | np.uint64(delbit & 1)
+    lo |= np.uint64(fh & 0x1FFFFFF) << np.uint64(39)
+    hi = np.uint32(fh >> 25)
+    out = np.zeros(1, KEY_DTYPE)
+    out["lo"] = lo
+    out["hi"] = hi
+    return out
+
+
+def unpack_keys(keys: np.ndarray) -> dict[str, np.ndarray]:
+    lo = keys["lo"].astype(np.uint64)
+    hi = keys["hi"].astype(np.uint64)
+    return {
+        "delbit": (lo & np.uint64(1)).astype(np.uint8),
+        "docid": (lo >> np.uint64(1)) & np.uint64((1 << 38) - 1),
+        "fieldhash": ((lo >> np.uint64(39)) & np.uint64(0x1FFFFFF))
+        | (hi << np.uint64(25)),
+    }
+
+
+def _range_of(field: str) -> tuple[np.ndarray, np.ndarray]:
+    fh = field_hash(field)
+    start = np.zeros(1, KEY_DTYPE)
+    end = np.zeros(1, KEY_DTYPE)
+    start["lo"] = np.uint64(fh & 0x1FFFFFF) << np.uint64(39)
+    start["hi"] = np.uint32(fh >> 25)
+    end["lo"] = (np.uint64(fh & 0x1FFFFFF) << np.uint64(39)) \
+        | np.uint64((1 << 39) - 1)
+    end["hi"] = np.uint32(fh >> 25)
+    return start[0], end[0]
+
+
+class Fielddb:
+    """Per-collection numeric field store over one Rdb."""
+
+    def __init__(self, directory: str | Path):
+        self.rdb = rdblite.Rdb("fielddb", directory, KEY_DTYPE,
+                               has_data=True)
+
+    @property
+    def empty(self) -> bool:
+        return len(self.rdb.mem) == 0 and not self.rdb.runs
+
+    def add(self, keys: np.ndarray, blobs) -> None:
+        self.rdb.add(keys, blobs)
+
+    def column(self, field: str) -> tuple[np.ndarray, np.ndarray]:
+        """(docids sorted u64, values f64) for one field — the merged,
+        tombstone-annihilated view."""
+        start, end = _range_of(field)
+        batch = self.rdb.get_list(start, end)
+        if not len(batch):
+            return np.empty(0, np.uint64), np.empty(0, np.float64)
+        f = unpack_keys(batch.keys)
+        vals = np.empty(len(batch), np.float64)
+        for i in range(len(batch)):
+            payload = batch.payload(i)
+            vals[i] = struct.unpack("<d", payload)[0] if payload \
+                else 0.0
+        return f["docid"], vals
+
+    def save(self) -> None:
+        self.rdb.save()
+
+
+def make_records(docid: int, fields: dict[str, float], delbit: int = 1):
+    """(keys, blobs) for one document's numeric fields."""
+    items = [(f, v) for f, v in sorted(fields.items())
+             if isinstance(v, (int, float)) and not isinstance(v, bool)]
+    if not items:
+        return np.empty(0, KEY_DTYPE), []
+    keys = np.concatenate([pack_key(f, docid, delbit) for f, _ in items])
+    blobs = [b"" if not delbit else struct.pack("<d", float(v))
+             for _, v in items]
+    return keys, blobs

@@ -142,6 +142,8 @@ class ShardNodeServer:
         #: writes accepted while a heal pull is in flight (replayed on
         #: top of the pulled snapshot — see heal_from)
         self._heal_buffer: list[dict] | None = None
+        #: last applied parm-broadcast sequence per name (0x3f dedup)
+        self._parm_seq: dict[str, int] = {}
 
     def _replay_journal(self) -> None:
         from ..build import docproc
@@ -180,6 +182,9 @@ class ShardNodeServer:
         if path == "/rpc/ping":
             # lock-free: a long write/checkpoint must not fail heartbeats
             return {"ok": True, "docs": self.coll.num_docs}
+        if path == "/rpc/conf":
+            # read-only conf dump (ops + broadcast verification)
+            return {"ok": True, "conf": self.coll.conf.to_dict()}
         if path == "/rpc/heal":
             # outside the writer lock: heal_from pulls for minutes and
             # takes the lock only for its atomic apply step — holding
@@ -230,6 +235,26 @@ class ShardNodeServer:
                 return {"ok": rec is not None, "doc": rec}
             if path == "/rpc/save":
                 self.save()
+                return {"ok": True}
+            if path == "/rpc/parm":
+                # live parm update (the 0x3f broadcast receive side,
+                # Parms.cpp:21683): host0's client sequences updates;
+                # stale/replayed sequence numbers are acked but not
+                # applied (retry-forever redelivery may duplicate)
+                seq = int(payload.get("seq", 0))
+                name = payload["name"]
+                if seq <= self._parm_seq.get(name, -1):
+                    return {"ok": True, "stale": True}
+                try:
+                    self.coll.conf.set(name, payload["value"],
+                                       _from_sync=True)
+                except KeyError as e:
+                    return {"ok": False, "error": str(e)}
+                self._parm_seq[name] = seq
+                # persist: the parm must survive this node's restart
+                self.coll.conf.save(self.coll._conf_path)
+                log.info("parm %s=%r applied (seq %d)", name,
+                         payload["value"], seq)
                 return {"ok": True}
             if path == "/rpc/pull":
                 # twin-patch send side (Msg5 error correction): ship one
@@ -482,6 +507,8 @@ class ClusterClient:
         #: signal (least-loaded twin serves reads)
         self._read_ewma = [[0.0] * conf.n_replicas
                            for _ in range(conf.n_shards)]
+        #: 0x3f broadcast sequencer (this client == the host0 role)
+        self._parm_counter = 0
         self._stop = threading.Event()
         self._pool = ThreadPoolExecutor(
             max_workers=max(4, 2 * conf.n_shards * conf.n_replicas))
@@ -599,6 +626,37 @@ class ClusterClient:
                 for r in range(self.conf.n_replicas)]
         for f in futs:
             f.result()
+
+    # --- parm broadcast (0x3f from host0, Parms.cpp:21683) ---------------
+
+    def broadcast_parm(self, name: str, value) -> None:
+        """Cluster-wide live parameter update: sequenced, delivered to
+        EVERY node (all shards, all twins) through the same ordered
+        retry-forever queues as writes — a dead node receives the parm
+        when it comes back, in order (Parms.h:497 broadcastParmList).
+        This client plays the reference's host0 role: the single
+        sequencer."""
+        self._parm_counter += 1
+        payload = {"name": name, "value": value,
+                   "seq": self._parm_counter}
+        for s in range(self.conf.n_shards):
+            self._write_all_twins(s, "/rpc/parm", payload)
+
+    def attach_conf(self, conf) -> None:
+        """Wire a CollectionConf's live updates to the cluster: any
+        ``conf.set(...)`` on this (host0) process broadcasts to every
+        node, unless the parm is flagged broadcast=False (e.g.
+        passwords)."""
+        from ..utils import parms as parms_mod
+
+        def fanout(name: str, value) -> None:
+            try:
+                if not parms_mod.parm(name).broadcast:
+                    return
+            except KeyError:
+                return
+            self.broadcast_parm(name, value)
+        conf.on_update(fanout)
 
     def index_document(self, url: str, content: str) -> int:
         docid = ghash.doc_id(url)

@@ -197,6 +197,8 @@ class ShardedCollection:
         for coll in self.replicas_of(home):
             coll.titledb.add(ml.titledb_key.reshape(1), [ml.title_rec])
             coll.clusterdb.add(ml.clusterdb_key.reshape(1))
+            if ml.fielddb_keys is not None and len(ml.fielddb_keys):
+                coll.fielddb.add(ml.fielddb_keys, ml.fielddb_blobs)
             coll.titlerec_cache.pop(ml.docid, None)
             coll.doc_added()
             if ml.words:
@@ -254,6 +256,8 @@ class ShardedCollection:
         for coll in self.replicas_of(home):
             coll.titledb.add(dead.titledb_key.reshape(1), [b""])
             coll.clusterdb.add(dead.clusterdb_key.reshape(1))
+            if dead.fielddb_keys is not None and len(dead.fielddb_keys):
+                coll.fielddb.add(dead.fielddb_keys, dead.fielddb_blobs)
             coll.titlerec_cache.pop(dead.docid, None)
             if dead.words:
                 coll.speller.remove_doc_words(dead.words)
@@ -356,6 +360,7 @@ def _pad_packed(pq: PackedQuery | None, T: int, L: int, D: int,
                 plan: QueryPlan, freqw: np.ndarray) -> PackedQuery:
     """Pad one shard's pack to the fleet-wide (T, L, D) bucket; ``None``
     becomes an all-invalid dummy block (empty Msg39 reply)."""
+    fl = plan.filters or plan.sortby is not None
     if pq is None:
         required, negative, scored, counts = group_flags(plan, T)
         return PackedQuery(
@@ -368,7 +373,11 @@ def _pad_packed(pq: PackedQuery | None, T: int, L: int, D: int,
             counts=counts, table=pad_table(plan.bool_table),
             cand_docids=np.empty(0, np.uint64),
             siterank=np.zeros(D, np.int32), doclang=np.zeros(D, np.int32),
-            n_docs=0, qlang=plan.lang)
+            n_docs=0, qlang=plan.lang,
+            filt=np.zeros(D, bool) if fl else None,
+            sortc=np.zeros(D, np.float32) if fl else None,
+            use_filter=bool(plan.filters),
+            use_sort=plan.sortby is not None)
     t, l = pq.doc_idx.shape
     d = len(pq.siterank)
     doc_idx = np.full((T, L), D, np.int32)
@@ -386,6 +395,14 @@ def _pad_packed(pq: PackedQuery | None, T: int, L: int, D: int,
     siterank[:d] = pq.siterank
     doclang = np.zeros(D, np.int32)
     doclang[:d] = pq.doclang
+    filt = sortc = None
+    if pq.filt is not None or pq.sortc is not None or fl:
+        filt = np.zeros(D, bool)
+        sortc = np.zeros(D, np.float32)
+        if pq.filt is not None:
+            filt[: len(pq.filt)] = pq.filt
+        if pq.sortc is not None:
+            sortc[: len(pq.sortc)] = pq.sortc
     return PackedQuery(
         doc_idx=doc_idx, payload=payload, slot=slot, valid=valid,
         freq_weight=_pad1(freqw, T, 0.5),
@@ -393,16 +410,19 @@ def _pad_packed(pq: PackedQuery | None, T: int, L: int, D: int,
         scored=pq.scored, counts=pq.counts, table=pq.table,
         cand_docids=pq.cand_docids,
         siterank=siterank, doclang=doclang, n_docs=pq.n_docs,
-        qlang=pq.qlang)
+        qlang=pq.qlang, filt=filt, sortc=sortc,
+        use_filter=pq.use_filter, use_sort=pq.use_sort)
 
 
 @partial(jax.jit, static_argnames=("mesh", "local_k", "out_k",
-                                   "n_positions"))
+                                   "n_positions", "use_filter",
+                                   "use_sort"))
 def _sharded_score(mesh, doc_idx, payload, slot, valid, freq_weight,
                    required, negative, scored, counts, table, siterank,
                    doclang, qlang,
-                   n_docs, local_k: int, out_k: int,
-                   n_positions: int = MAX_POSITIONS):
+                   n_docs, filt, sortc, local_k: int, out_k: int,
+                   n_positions: int = MAX_POSITIONS,
+                   use_filter: bool = False, use_sort: bool = False):
     """shard_map program: per-shard intersect+score, in-mesh top-k merge.
 
     Inputs carry a leading shard axis [S, ...]; outputs are replicated:
@@ -415,11 +435,13 @@ def _sharded_score(mesh, doc_idx, payload, slot, valid, freq_weight,
     rep = P()
 
     def per_shard(di, pl, sl, va, fw, rq, ng, sc, ct, tb, sr, dl, ql,
-                  nd):
+                  nd, ft, so):
         n_matched, ts, ti = score_core(
             di[0], pl[0], sl[0], va[0], fw[0], rq[0], ng[0], sc[0],
             ct[0], tb[0], sr[0], dl[0], ql[0], nd[0],
-            n_positions=n_positions, topk=local_k)
+            n_positions=n_positions, topk=local_k,
+            filt=ft[0], sortc=so[0],
+            use_filter=use_filter, use_sort=use_sort)
         k = ts.shape[0]
         # Msg3a merge as an ICI collective: gather every shard's top-k,
         # take the global top-k (replicated on all shards)
@@ -441,11 +463,12 @@ def _sharded_score(mesh, doc_idx, payload, slot, valid, freq_weight,
 
     return jax.shard_map(
         per_shard, mesh=mesh,
-        in_specs=(spec,) * 14,
+        in_specs=(spec,) * 16,
         out_specs=rep,
         check_vma=False,
     )(doc_idx, payload, slot, valid, freq_weight, required, negative,
-      scored, counts, table, siterank, doclang, qlang, n_docs)
+      scored, counts, table, siterank, doclang, qlang, n_docs, filt,
+      sortc)
 
 
 def _global_freq_weights(preps: list[PreparedQuery | None],
@@ -476,7 +499,17 @@ def sharded_search(sc: ShardedCollection, q: str | QueryPlan, *,
     # hosts on PageHosts; silent partial results are a correctness trap)
     serving = [sc.hostmap.serving_replica(s) for s in range(sc.n_shards)]
     degraded = any(r is None for r in serving)
-    preps = [prepare_query(c, plan) if serving[i] is not None else None
+    # cross-shard sort-key base (gbsortby): every shard shifts by the
+    # same minimum or the merged ordering is wrong
+    sort_base = None
+    if plan.sortby is not None:
+        from ..query.packer import local_sort_base
+        bases = [local_sort_base(c, *plan.sortby)
+                 for i, c in enumerate(sc.shards)
+                 if serving[i] is not None]
+        sort_base = min(bases) if bases else 0.0
+    preps = [prepare_query(c, plan, sort_base=sort_base)
+             if serving[i] is not None else None
              for i, c in enumerate(sc.shards)]
     freqw = _global_freq_weights(preps, plan, sc.num_docs)
 
@@ -511,6 +544,10 @@ def sharded_search(sc: ShardedCollection, q: str | QueryPlan, *,
         doclang=stack(lambda p: p.doclang),
         qlang=np.full(sc.n_shards, plan.lang, np.int32),
         n_docs=stack(lambda p: np.int32(p.n_docs)),
+        filt=stack(lambda p: p.filt if p.filt is not None
+                   else np.zeros(len(p.siterank), bool)),
+        sortc=stack(lambda p: p.sortc if p.sortc is not None
+                    else np.zeros(len(p.siterank), np.float32)),
     )
     # lay the shard axis over the mesh so each device holds its own block
     sharded_args = {
@@ -537,7 +574,10 @@ def sharded_search(sc: ShardedCollection, q: str | QueryPlan, *,
             sharded_args["counts"], sharded_args["table"],
             sharded_args["siterank"], sharded_args["doclang"],
             sharded_args["qlang"], sharded_args["n_docs"],
-            local_k=k, out_k=kk))
+            sharded_args["filt"], sharded_args["sortc"],
+            local_k=k, out_k=kk,
+            use_filter=bool(plan.filters),
+            use_sort=plan.sortby is not None))
         total = int(out[0])
         m_shard = out[1:1 + kk].astype(np.int64)
         m_local = out[1 + kk:1 + 2 * kk].astype(np.int64)
@@ -571,6 +611,118 @@ def sharded_search(sc: ShardedCollection, q: str | QueryPlan, *,
         query=plan.raw, total_matches=int(total), results=page,
         clustered=clustered, degraded=degraded,
         suggestion=suggest_sharded(sc, plan) if total == 0 else None)
+
+
+class MeshResident:
+    """The PRODUCTION resident index on a device mesh: one
+    HBM-resident :class:`~..query.devindex.DeviceIndex` per shard,
+    PINNED to its own chip — N shards execute their two-phase /
+    direct-cube kernels concurrently on N devices (jit dispatches
+    follow the committed operands' device; the host thread pool only
+    overlaps the dispatch+fetch round trips).
+
+    Architecture note (why the merge seam is host-side here): each
+    shard routes every query adaptively (F1 κ rung vs direct-cube) by
+    ITS OWN term statistics and runs its own lossless escalation
+    ladder, so the per-shard execution is a host-driven loop — exactly
+    the reference's Msg39 boundary (``Msg39.cpp:74``), where each host
+    intersects independently and Msg3a merges the tiny top-k replies
+    (``Msg3a.cpp:971``). The k-way merge of S·k (docid, score) rows is
+    microseconds of numpy; the in-mesh all-gather merge remains on the
+    ``sharded_search`` path where the per-shard program is a single
+    fused kernel. Cross-shard score comparability holds because every
+    shard plans with CLUSTER-WIDE term frequencies (global dfs), like
+    the reference's Msg39Request termFreqWeights.
+    """
+
+    def __init__(self, sc: ShardedCollection, devices=None):
+        self.sc = sc
+        if devices is None:
+            devices = jax.devices()
+        if len(devices) < sc.n_shards:
+            # fewer chips than shards: wrap (several shards per chip —
+            # still correct, just time-shared)
+            devices = [devices[s % len(devices)]
+                       for s in range(sc.n_shards)]
+        from ..query.devindex import DeviceIndex
+        self.indexes = [DeviceIndex(sc.shards[s], device=devices[s])
+                        for s in range(sc.n_shards)]
+        from concurrent.futures import ThreadPoolExecutor
+        self._pool = ThreadPoolExecutor(max(sc.n_shards, 1))
+
+    def refresh(self) -> None:
+        for di in self.indexes:
+            di.refresh()
+
+    def warm(self) -> None:
+        list(self._pool.map(lambda di: di.warm(), self.indexes))
+
+    def _global_df(self, termid: int) -> int:
+        return sum(di._df_of(termid) for di in self.indexes)
+
+    def _global_sort_base(self, fld: str, desc: bool) -> float:
+        return min(di.sort_base_of(fld, desc) for di in self.indexes)
+
+    def search_batch(self, queries, topk: int = 10, lang: int = 0,
+                     offset: int = 0, with_snippets: bool = True,
+                     site_cluster: bool = True) -> list[SearchResults]:
+        """B queries × S shards: per-shard resident kernels run
+        concurrently (different chips), then the Msg3a merge + the
+        shared Msg40 tail per query."""
+        from ..query.engine import PQR_SCAN, finish_page
+        sc = self.sc
+        plans = [q if isinstance(q, QueryPlan) else
+                 compile_query(q, lang=lang) for q in queries]
+        total_docs = sc.num_docs
+        want = max(topk + offset, PQR_SCAN)
+        k_shard = max(want * 2, 64)
+
+        def run_shard(di):
+            return di.search_batch(
+                plans, topk=k_shard, lang=lang,
+                df_of=self._global_df, total_docs=total_docs,
+                sort_base_of=self._global_sort_base)
+
+        per_shard = list(self._pool.map(run_shard, self.indexes))
+
+        out = []
+        for qi, plan in enumerate(plans):
+            docids = np.concatenate(
+                [per_shard[s][qi][0] for s in range(sc.n_shards)])
+            scores = np.concatenate(
+                [per_shard[s][qi][1] for s in range(sc.n_shards)])
+            total = sum(int(per_shard[s][qi][2])
+                        for s in range(sc.n_shards))
+            order = np.argsort(-scores, kind="stable")
+
+            def site_of(docid, _sc=sc):
+                home = int(_sc.hostmap.shard_of_docid(docid))
+                return self.indexes[home].sitehash_of(docid)
+
+            results, clustered = build_results(
+                sc.get_document, docids[order], scores[order], plan,
+                topk=want, with_snippets=False,
+                site_cluster=site_cluster, site_of=site_of)
+            page = finish_page(
+                results, offset=offset, topk=topk,
+                conf=sc.shards[0].conf, qlang=plan.lang,
+                langid_of=lambda d: self.indexes[
+                    int(sc.hostmap.shard_of_docid(d))].langid_of(d),
+                get_doc=sc.get_document,
+                words=[g.display for g in plan.scored_groups],
+                with_snippets=with_snippets)
+            from ..query.engine import compute_facets
+            out.append(SearchResults(
+                query=plan.raw, total_matches=total, results=page,
+                clustered=clustered,
+                suggestion=suggest_sharded(sc, plan)
+                if total == 0 else None,
+                facets=compute_facets(plan, docids[order],
+                                      sc.get_document)))
+        return out
+
+    def search(self, q, **kw) -> SearchResults:
+        return self.search_batch([q], **kw)[0]
 
 
 def suggest_sharded(sc: ShardedCollection, plan: QueryPlan) -> str | None:

@@ -158,6 +158,13 @@ class QueryPlan:
     lang: int = 0  # 0 = any (reference &qlang)
     #: boolean truth table over presence bits (None = plain conjunctive)
     bool_table: np.ndarray | None = None
+    #: numeric range constraints: field → [min, max] (gbmin:/gbmax:,
+    #: reference fielded numeric terms Query.h:209)
+    filters: dict = field(default_factory=dict)
+    #: sort override: (field, reverse) — gbsortby:/gbsortbyrev:
+    sortby: tuple | None = None
+    #: facet requests: field names (gbfacet:field, qa.cpp:2910 qajson)
+    facets: list = field(default_factory=list)
 
     @property
     def scored_groups(self) -> list[TermGroup]:
@@ -191,6 +198,29 @@ def compile_query(q: str, lang: int = 0,
         if m.group("field") is not None:
             fname = m.group("field").lower()
             fval = m.group("fval").strip('"')
+            if fname in ("gbmin", "gbmax") and ":" in fval:
+                # gbmin:price:10 — numeric range gate on a fielddb
+                # column (reference numeric fielded terms, Query.h:209)
+                fld, _, num = fval.rpartition(":")
+                try:
+                    v = float(num)
+                except ValueError:
+                    continue
+                lohi = plan.filters.setdefault(
+                    fld.lower(), [float("-inf"), float("inf")])
+                if fname == "gbmin":
+                    lohi[0] = max(lohi[0], v)
+                else:
+                    lohi[1] = min(lohi[1], v)
+                continue
+            if fname in ("gbsortby", "gbsortbyrev"):
+                plan.sortby = (fval.lower(), fname == "gbsortby")
+                # gbsortby:date descends (newest first) by default —
+                # reference gbsortby sorts descending by field value
+                continue
+            if fname == "gbfacet":
+                plan.facets.append(fval.lower())
+                continue
             if fname in FILTER_FIELDS:
                 tid = ghash.term_id(fval, prefix=FILTER_FIELDS[fname])
                 plan.groups.append(TermGroup(

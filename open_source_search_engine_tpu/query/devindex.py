@@ -411,12 +411,27 @@ class ResidentPlan:
     #: True only for boolean queries — non-boolean waves compile the
     #: truth-table gate out (its [D]-wide gather costs ~140 ms/wave)
     has_table: bool = False
+    #: numeric range constraints / sort override (gbmin:/gbmax:/
+    #: gbsortby: — waves group by identical specs; the [D] filter and
+    #: sort columns are per-wave kernel args)
+    filters: tuple = ()
+    sortby: tuple | None = None
+    #: shift applied to sort keys (keys must stay positive for the
+    #: match gate) — the MESH layer passes the cross-shard minimum so
+    #: per-shard keys stay comparable under the Msg3a merge
+    sort_base: float = 0.0
 
 
 class DeviceIndex:
     """One collection's postings + impact bounds, resident in HBM."""
 
-    def __init__(self, coll: Collection, max_positions: int = MAX_POSITIONS):
+    def __init__(self, coll: Collection, max_positions: int = MAX_POSITIONS,
+                 device=None):
+        #: device pinning: a mesh of chips serves one shard per chip —
+        #: every resident array and kernel dispatch for this index
+        #: stays on ``device`` (jit follows committed operands), so N
+        #: shards execute concurrently on N chips
+        self.device = device
         self.coll = coll
         self.P = max_positions
         self._built_version = -1
@@ -425,6 +440,10 @@ class DeviceIndex:
         self.delta_rebuilds = 0   # O(memtable) delta-only refreshes
         self.escalations = 0      # phase-2 κ escalations (pruning misses)
         self.refresh()
+
+    def _put(self, a):
+        return jax.device_put(a, self.device) if self.device is not None \
+            else jax.device_put(a)
 
     # --- build / refresh -------------------------------------------------
 
@@ -436,6 +455,9 @@ class DeviceIndex:
         if rdb.version == self._built_version:
             return False
         self._sitehash = None  # clusterdb view refreshes lazily
+        self._fcols = {}        # fielddb columns re-derive
+        self._fswave = {}
+        self._docid_sorted = None  # sorted docid view rebuilds
         # content-addressed fingerprint: keys_crc makes a rebuilt run
         # with a coincidentally identical (name, count) miss the cache
         fp = tuple((r.path.name, len(r), r.meta.get("keys_crc"))
@@ -640,22 +662,22 @@ class DeviceIndex:
         self.N2 = max(_bucket(max(self.Nb // 4, min_delta, 1),
                               COL_QUANTUM), COL_QUANTUM)
         self.M2 = self.N2
-        self.d_payload = jax.device_put(
+        self.d_payload = self._put(
             _pad_col(payload, self.Nb + self.N2))
-        self.d_pdoc = jax.device_put(_pad_col(docidx, self.Nb + self.N2))
-        self.d_pocc = jax.device_put(_pad_col(pocc, self.Nb + self.N2))
-        self.d_doc = jax.device_put(_pad_col(doc_col, self.Mb + self.M2))
-        self.d_imp = jax.device_put(_pad_col(imp_col, self.Mb + self.M2))
-        self.d_rsp = jax.device_put(_pad_col(rsp_col, self.Mb + self.M2))
+        self.d_pdoc = self._put(_pad_col(docidx, self.Nb + self.N2))
+        self.d_pocc = self._put(_pad_col(pocc, self.Nb + self.N2))
+        self.d_doc = self._put(_pad_col(doc_col, self.Mb + self.M2))
+        self.d_imp = self._put(_pad_col(imp_col, self.Mb + self.M2))
+        self.d_rsp = self._put(_pad_col(rsp_col, self.Mb + self.M2))
         dr_cum = np.r_[0, np.cumsum(dr_lens)].astype(np.int32)
         self.d_dense_imp, self.d_dense_rsp = _build_dense_rows(
             self.d_doc, self.d_imp, self.d_rsp,
-            jax.device_put(dr_starts), jax.device_put(dr_cum),
+            self._put(dr_starts), self._put(dr_cum),
             V=V, D=self.D_cap,
             n_lanes=_bucket(max(int(dr_cum[-1]), 1), COL_QUANTUM))
-        self.d_siterank = jax.device_put(sr)
-        self.d_doclang = jax.device_put(dl)
-        self.d_dead = jax.device_put(np.zeros(self.D_cap, bool))
+        self.d_siterank = self._put(sr)
+        self.d_doclang = self._put(dl)
+        self.d_dead = self._put(np.zeros(self.D_cap, bool))
         self.Vc = Vc
         total = Vc * P * self.D_cap
         if cube_src:
@@ -666,8 +688,8 @@ class DeviceIndex:
             dstp[: len(cdst)] = cdst
             self.d_cube = _build_cube_rows(
                 self.d_payload,
-                jax.device_put(_pad_col(csrc.astype(np.int32), ncube)),
-                jax.device_put(dstp), total=total)
+                self._put(_pad_col(csrc.astype(np.int32), ncube)),
+                self._put(dstp), total=total)
         else:
             self.d_cube = jnp.zeros((total,), jnp.uint32)
         self._base_fp = fp
@@ -690,7 +712,7 @@ class DeviceIndex:
         dead = np.zeros(self.D_cap, bool)
         if not len(mem):
             self._set_empty_delta()
-            self.d_dead = jax.device_put(dead)
+            self.d_dead = self._put(dead)
             self.delta_rebuilds += 1
             return
         f = posdb.unpack(mem.keys)
@@ -792,22 +814,22 @@ class DeviceIndex:
             # donated in-place rewrites of the delta tails
             self.d_payload = _write_tail(
                 self.d_payload,
-                jax.device_put(_pad_col(payload2, self.N2)),
+                self._put(_pad_col(payload2, self.N2)),
                 np.int32(self.Nb))
             self.d_pdoc = _write_tail(
-                self.d_pdoc, jax.device_put(_pad_col(docidx, self.N2)),
+                self.d_pdoc, self._put(_pad_col(docidx, self.N2)),
                 np.int32(self.Nb))
             self.d_pocc = _write_tail(
-                self.d_pocc, jax.device_put(_pad_col(pocc2, self.N2)),
+                self.d_pocc, self._put(_pad_col(pocc2, self.N2)),
                 np.int32(self.Nb))
             self.d_doc = _write_tail(
-                self.d_doc, jax.device_put(_pad_col(doc2_col, self.M2)),
+                self.d_doc, self._put(_pad_col(doc2_col, self.M2)),
                 np.int32(self.Mb))
             self.d_imp = _write_tail(
-                self.d_imp, jax.device_put(_pad_col(imp2, self.M2)),
+                self.d_imp, self._put(_pad_col(imp2, self.M2)),
                 np.int32(self.Mb))
             self.d_rsp = _write_tail(
-                self.d_rsp, jax.device_put(_pad_col(rsp2, self.M2)),
+                self.d_rsp, self._put(_pad_col(rsp2, self.M2)),
                 np.int32(self.Mb))
         else:
             self._set_empty_delta()
@@ -824,7 +846,7 @@ class DeviceIndex:
                 self.d_siterank, self.d_doclang,
                 bpad(upd_idx, upd_idx[0]), bpad(upd_sr, upd_sr[0]),
                 bpad(upd_dl, upd_dl[0]))
-        self.d_dead = jax.device_put(dead)
+        self.d_dead = self._put(dead)
         self.delta_rebuilds += 1
 
     def _set_empty_delta(self) -> None:
@@ -840,6 +862,24 @@ class DeviceIndex:
     def n_docs(self) -> int:
         return len(self.all_docids)
 
+    def _docid_pos(self, docids_arr: np.ndarray) -> tuple[np.ndarray,
+                                                          np.ndarray]:
+        """(row positions, found mask) of docids in all_docids.
+        all_docids = [sorted base] + [sorted delta] — NOT globally
+        sorted once a delta exists, so binary search needs the sorted
+        view + inverse permutation (rebuilt per refresh)."""
+        if getattr(self, "_docid_sorted", None) is None or \
+                len(self._docid_order) != len(self.all_docids):
+            self._docid_order = np.argsort(self.all_docids,
+                                           kind="stable")
+            self._docid_sorted = self.all_docids[self._docid_order]
+        pos = np.searchsorted(self._docid_sorted, docids_arr)
+        ok = pos < len(self._docid_sorted)
+        ok[ok] = self._docid_sorted[pos[ok]] == docids_arr[ok]
+        rows = np.zeros(len(docids_arr), np.int64)
+        rows[ok] = self._docid_order[pos[ok]]
+        return rows, ok
+
     def _cluster_cols(self):
         """Lazily materialized clusterdb columns aligned to all_docids
         (Clusterdb.h:42 — sitehash + langid per docid, dataless)."""
@@ -849,11 +889,9 @@ class DeviceIndex:
             lg = np.zeros(len(self.all_docids), np.int64)
             if len(cl):
                 f = clusterdb_mod.unpack_key(cl.keys)
-                pos = np.searchsorted(self.all_docids, f["docid"])
-                ok = pos < len(self.all_docids)
-                ok[ok] = self.all_docids[pos[ok]] == f["docid"][ok]
-                sh[pos[ok]] = f["sitehash"][ok].astype(np.int64)
-                lg[pos[ok]] = f["langid"][ok].astype(np.int64)
+                rows, ok = self._docid_pos(f["docid"])
+                sh[rows[ok]] = f["sitehash"][ok].astype(np.int64)
+                lg[rows[ok]] = f["langid"][ok].astype(np.int64)
             self._sitehash = sh
             self._langid_col = lg
         return self._sitehash, self._langid_col
@@ -864,20 +902,88 @@ class DeviceIndex:
         — site clustering runs off this column WITHOUT touching titledb
         until the summary stage. Lazily built, aligned to all_docids."""
         sh, _ = self._cluster_cols()
-        i = int(np.searchsorted(self.all_docids, np.uint64(docid)))
-        if i < len(self.all_docids) and self.all_docids[i] == docid:
-            return int(sh[i])
-        return 0
+        rows, ok = self._docid_pos(np.array([docid], np.uint64))
+        return int(sh[rows[0]]) if ok[0] else 0
 
     def langid_of(self, docid: int) -> int:
         """Docid → langid from the same clusterdb columns (feeds the
         PostQueryRerank foreign-language demotion without a titlerec
         fetch)."""
         _, lg = self._cluster_cols()
-        i = int(np.searchsorted(self.all_docids, np.uint64(docid)))
-        if i < len(self.all_docids) and self.all_docids[i] == docid:
-            return int(lg[i])
-        return 0
+        rows, ok = self._docid_pos(np.array([docid], np.uint64))
+        return int(lg[rows[0]]) if ok[0] else 0
+
+    # --- fielddb columns (gbmin/gbmax/gbsortby — the datedb role) -------
+
+    def _field_col(self, fld: str) -> np.ndarray:
+        """Dense f64 [n_docs] column for one field aligned to
+        all_docids (NaN = doc has no value), cached per Rdb version."""
+        cache = getattr(self, "_fcols", None)
+        if cache is None:
+            cache = self._fcols = {}
+        ver = self.coll.fielddb.rdb.version
+        hit = cache.get((fld, ver))
+        if hit is not None:
+            return hit
+        docids, vals = self.coll.fielddb.column(fld)
+        col = np.full(len(self.all_docids), np.nan)
+        if len(docids):
+            rows, ok = self._docid_pos(docids)
+            col[rows[ok]] = vals[ok]
+        if len(cache) > 32:
+            cache.clear()
+        cache[(fld, ver)] = col
+        return col
+
+    def sort_base_of(self, fld: str, desc: bool) -> float:
+        """This shard's minimum finite sort key for a field (keys are
+        v for descending, -v for ascending)."""
+        col = self._field_col(fld)
+        key = col if desc else -col
+        fin = np.isfinite(key)
+        return float(key[fin].min()) if fin.any() else 0.0
+
+    def _filter_sort_cols(self, p: "ResidentPlan"):
+        """(d_filter, d_sort, use_filter, use_sort) for one wave —
+        device-cached per (spec, fielddb version). The filter is the
+        AND of every field's range mask; the sort column is shifted
+        positive (matched docs must stay > 0) with missing-field docs
+        ranked below every real value."""
+        spec = (p.filters, p.sortby, p.sort_base)
+        ver = self.coll.fielddb.rdb.version
+        cache = getattr(self, "_fswave", None)
+        if cache is None:
+            cache = self._fswave = {}
+        hit = cache.get((spec, ver))
+        if hit is not None:
+            return hit
+        use_filter = bool(p.filters)
+        use_sort = p.sortby is not None
+        if use_filter:
+            mask = np.ones(len(self.all_docids), bool)
+            for fld, (lo, hi) in p.filters:
+                col = self._field_col(fld)
+                with np.errstate(invalid="ignore"):
+                    mask &= (col >= lo) & (col <= hi)  # NaN fails both
+            fpad = np.zeros(self.D_cap, bool)
+            fpad[: len(mask)] = mask
+        else:
+            fpad = np.zeros(self.D_cap, bool)
+        if use_sort:
+            fld, desc = p.sortby
+            col = self._field_col(fld).copy()
+            key = col if desc else -col
+            finite = np.isfinite(key)
+            key = np.where(finite, key - p.sort_base + 1.0, 0.25)
+            spad = np.zeros(self.D_cap, np.float32)
+            spad[: len(key)] = key.astype(np.float32)
+        else:
+            spad = np.zeros(self.D_cap, np.float32)
+        out = (self._put(fpad), self._put(spad), use_filter, use_sort)
+        if len(cache) > 16:
+            cache.clear()
+        cache[(spec, ver)] = out
+        return out
 
     # --- planning --------------------------------------------------------
 
@@ -918,7 +1024,15 @@ class DeviceIndex:
             df += int(self.delta_df[j])
         return max(df, 0)
 
-    def plan(self, qplan: QueryPlan) -> ResidentPlan:
+    def plan(self, qplan: QueryPlan, df_of=None,
+             total_docs: int | None = None,
+             sort_base_of=None) -> ResidentPlan:
+        """``df_of``/``total_docs``/``sort_base_of`` override the
+        corpus-wide stats: the mesh layer passes CLUSTER-WIDE dfs (and
+        the cluster-wide sort-key base for gbsortby) so every shard
+        weighs terms identically and cross-shard scores merge
+        comparably (the reference ships global termFreqWeights in the
+        Msg39 request)."""
         T = _bucket(max(len(qplan.groups), 1), T_FLOOR)
         drows, srows, crows, prows = [], [], [], []
         dfs = np.zeros(max(len(qplan.groups), 1), np.int64)
@@ -982,7 +1096,7 @@ class DeviceIndex:
                                           g_i, base, quota, syn,
                                           is_base))
                     any_postings = True
-                gdf = max(gdf, self._df_of(sub.termid))
+                gdf = max(gdf, (df_of or self._df_of)(sub.termid))
             dfs[g_i] = gdf
             groups_have_postings.append(any_postings)
             # direct-cube qualification: cube runs must be base runs at
@@ -1029,8 +1143,10 @@ class DeviceIndex:
 
         required, negative, scored, counts = group_flags(qplan, T)
         freqw = _pad1(
-            weights.term_freq_weight(dfs[: len(qplan.groups)],
-                                     max(self.coll.num_docs, 1)), T, 0.5)
+            weights.term_freq_weight(
+                dfs[: len(qplan.groups)],
+                max(total_docs if total_docs is not None
+                    else self.coll.num_docs, 1)), T, 0.5)
         da = np.array(drows, np.int64).reshape(-1, 5)
         sa = np.array(srows, np.int64).reshape(-1, 7)
         ca = np.array(crows, np.int64).reshape(-1, 6)
@@ -1067,7 +1183,13 @@ class DeviceIndex:
             qlang=qplan.lang, matchable=matchable,
             driver_df=0 if driver_df == 1 << 60 else int(driver_df),
             direct_ok=direct_ok, g_quarter=g_quarter, g_qsyn=g_qsyn,
-            has_table=qplan.bool_table is not None)
+            has_table=qplan.bool_table is not None,
+            filters=tuple(sorted(
+                (f, tuple(v)) for f, v in qplan.filters.items())),
+            sortby=qplan.sortby,
+            sort_base=(
+                (sort_base_of or self.sort_base_of)(*qplan.sortby)
+                if qplan.sortby is not None else 0.0))
 
     # --- execution -------------------------------------------------------
 
@@ -1075,7 +1197,9 @@ class DeviceIndex:
         """One query → (docids, scores, n_matched)."""
         return self.search_batch([q], topk=topk, lang=lang)[0]
 
-    def search_batch(self, queries, topk: int = 64, lang: int = 0):
+    def search_batch(self, queries, topk: int = 64, lang: int = 0,
+                     df_of=None, total_docs: int | None = None,
+                     sort_base_of=None):
         """Batched execution: B queries per device round trip (vmap over
         the query axis). Routing: drivers with a bounded doc set use the
         two-phase pruned kernel (F1); corpus-wide drivers go to the
@@ -1084,7 +1208,9 @@ class DeviceIndex:
         t_plan = time.perf_counter()
         qplans = [q if isinstance(q, QueryPlan) else compile_query(q, lang)
                   for q in queries]
-        plans = [self.plan(qp) for qp in qplans]
+        plans = [self.plan(qp, df_of=df_of, total_docs=total_docs,
+                           sort_base_of=sort_base_of)
+                 for qp in qplans]
         g_stats.record_ms("devindex.plan",
                           1000 * (time.perf_counter() - t_plan))
         live = [i for i, p in enumerate(plans) if p.matchable]
@@ -1152,11 +1278,14 @@ class DeviceIndex:
                 else:
                     k2i = kapi
                 groups.setdefault(
-                    (kapi, k2i, plans[i].has_table), []).append(i)
-            for (kappa, k2g, _ut), idxs in sorted(groups.items()):
-                # terminal rungs chunk at 4 so the [T, P, k2]·B
+                    (kapi, k2i, plans[i].has_table,
+                     plans[i].filters, plans[i].sortby), []).append(i)
+            for (kappa, k2g, *_spec), idxs in sorted(
+                    groups.items(), key=lambda kv: str(kv[0])):
+                # terminal rungs chunk small so the [T, P, k2]·B
                 # phase-2 intermediates stay bounded at k2 = D_cap
-                step = 64 if k2g <= 32 * KAPPA_FLOOR else 4
+                step = self._f1_bmax() if k2g <= 32 * KAPPA_FLOOR \
+                    else self._f1_step_terminal()
                 for a in range(0, len(idxs), step):
                     chunk = idxs[a:a + step]
                     waves.append(("f1", kappa, k2g, chunk,
@@ -1174,16 +1303,35 @@ class DeviceIndex:
                 return 512 if ml <= 512 else (
                     F2_LPOST_FLOOR if ml <= F2_LPOST_FLOOR
                     else F2_SCATTER_MAX)
-            fd.sort(key=lambda i: (_lp_of(i), plans[i].has_table))
-            fg.sort(key=lambda i: plans[i].has_table)
-            for a in range(0, len(fd), 16):
-                chunk = fd[a:a + 16]
-                waves.append(("f2", 0, k2v, chunk, self._run_batch_fd(
-                    [plans[i] for i in chunk], k2v, f2_nsel)))
-            for a in range(0, len(fg), bmax):
-                chunk = fg[a:a + bmax]
-                waves.append(("f2", 0, k2v, chunk, self._run_batch_f2(
-                    [plans[i] for i in chunk], k2v, f2_nsel)))
+            # HARD-partition F2/FD waves by (Lp, filter/sort spec):
+            # the filter and sort columns are per-wave kernel args, so
+            # a chunk must never mix specs
+            spec_of = lambda i: (plans[i].filters, plans[i].sortby,
+                                 plans[i].has_table)
+            fd_parts: dict = {}
+            for i in fd:
+                fd_parts.setdefault((_lp_of(i), spec_of(i)),
+                                    []).append(i)
+            fd_step = max(4, min(16, self._f2_bmax()))
+            for _, idxs in sorted(fd_parts.items(),
+                                  key=lambda kv: str(kv[0])):
+                for a in range(0, len(idxs), fd_step):
+                    chunk = idxs[a:a + fd_step]
+                    waves.append(("f2", 0, k2v, chunk,
+                                  self._run_batch_fd(
+                                      [plans[i] for i in chunk],
+                                      k2v, f2_nsel)))
+            fg_parts: dict = {}
+            for i in fg:
+                fg_parts.setdefault(spec_of(i), []).append(i)
+            for _, idxs in sorted(fg_parts.items(),
+                                  key=lambda kv: str(kv[0])):
+                for a in range(0, len(idxs), bmax):
+                    chunk = idxs[a:a + bmax]
+                    waves.append(("f2", 0, k2v, chunk,
+                                  self._run_batch_f2(
+                                      [plans[i] for i in chunk],
+                                      k2v, f2_nsel)))
             g_stats.record_ms("devindex.issue",
                               1000 * (time.perf_counter() - t_issue))
             t_fetch = time.perf_counter()
@@ -1267,8 +1415,10 @@ class DeviceIndex:
         k2 = min(128, self.D_cap)
         kap = min(KAPPA_FLOOR, self.D_cap)
         shape_grid = ((1, 1), (2, 1), (1, 2), (3, 3), (5, 5), (17, 1))
+        b1 = self._f1_bmax()
+        nbs = tuple(sorted({1, min(5, b1), min(9, b1), min(33, b1)}))
         for ns, nd in shape_grid:          # κ=256 base rung
-            for nb in (1, 5, 9, 33):       # B = 4 / 8 / 32 / 64
+            for nb in nbs:                 # B buckets the budget allows
                 # single-group (k2=128) AND multi-group (k2=κ) widths
                 outs.append(self._run_batch(
                     [dummy(ns=ns, nd=nd)] * nb, kap, min(k2, kap)))
@@ -1292,9 +1442,11 @@ class DeviceIndex:
                                         kap32))
             outs.append(self._run_batch([dummy(ns=ns, nd=nd)] * 5,
                                         kap32, kap32))
+        # B > 4 buckets exist only when the HBM budget allows them
+        nb_big = (1, 5) if self._f2_bmax() > 4 else (1,)
         for n_sel in (2048, 8192):  # F2 base + first escalation rung
             for np_rows in (1, 9):
-                for nb in (1, 5):  # B = 4 and B = bmax buckets
+                for nb in nb_big:  # B = 4 and (budget allowing) B = bmax
                     p = dummy(np_rows=np_rows)
                     p.p_len[:] = 1
                     outs.append(self._run_batch_f2(
@@ -1318,7 +1470,7 @@ class DeviceIndex:
         pl.g_qsyn = np.zeros((T, 4), np.uint32)
         pl.p_len[0] = 513  # Lp=4096 bucket
         for n_sel in (2048, 8192):
-            for nb in (1, 5):
+            for nb in nb_big:
                 outs.append(self._run_batch_fd(
                     [pd] * nb, k2, min(n_sel, self.D_cap)))
                 if n_sel == 2048:
@@ -1368,6 +1520,23 @@ class DeviceIndex:
                 return min(rung, self.D_cap)
         return min(_bucket(need, KAPPA_FLOOR), self.D_cap)
 
+    def _f1_bmax(self) -> int:
+        """Largest F1 wave B the HBM budget allows (power of two ≤ 64):
+        phase-1 intermediates run ~176·D bytes per lane (the [2, T, D]
+        scatter target plus the [T, D] bound chains) — at 100k docs
+        B=64 fits easily; at the 500k-doc shard cap it must drop or the
+        wave OOMs next to the ~7 GB resident set."""
+        cap = max(4, (2 << 30) // (176 * self.D_cap))
+        b = 4
+        while b * 2 <= cap and b < 64:
+            b *= 2
+        return b
+
+    def _f1_step_terminal(self) -> int:
+        """Terminal-rung (k2 = D_cap) chunk size: the exact-scoring
+        cube chain costs ~2048·D bytes per lane."""
+        return max(1, min(4, (2 << 30) // (2048 * self.D_cap)))
+
     def _f2_bmax(self) -> int:
         """F2 batch cap: full-cube intermediates are ~48 bytes/doc/query
         ([T,P,D] cube+validity+scores) — bound them to ~1.5 GB (wave
@@ -1395,14 +1564,20 @@ class DeviceIndex:
         # (single-query latency, minority rungs) drop to B=4. κ no
         # longer constrains B: phase 2 is k2-wide (k2 ≪ κ), so big-κ
         # rungs only pay a wider selection pass
+        bmax = self._f1_bmax()
         if len(plans) <= 4:
             B = 4
         elif len(plans) <= 8:
             B = 8
+        elif len(plans) <= 16:
+            B = 16
         elif len(plans) <= 32:
             B = 32
         else:
             B = 64
+        B = min(B, bmax)
+        if len(plans) > B:  # stray caller overshoot: correctness first
+            B = _bucket(len(plans), 4)
 
         def pad_plan(p: ResidentPlan | None):
             if p is None:
@@ -1448,13 +1623,15 @@ class DeviceIndex:
         # host args ride the (async) dispatch; returned WITHOUT fetching
         # — the caller fetches every wave's output in ONE device_get
         # (each separate blocking fetch costs a full ~100 ms tunnel RTT)
+        d_filter, d_sort, uf, us = self._filter_sort_cols(plans[0])
         return _two_phase(
             self.d_payload, self.d_doc, self.d_imp, self.d_rsp,
             self.d_dense_imp, self.d_dense_rsp,
             self.d_siterank, self.d_doclang, self.d_dead,
-            np.int32(self.n_docs), sel, *args,
+            np.int32(self.n_docs), d_filter, d_sort, sel, *args,
             n_positions=self.P, lsp=Lsp, kappa=kappa, k2=k2,
-            use_table=any(p.has_table for p in plans))
+            use_table=any(p.has_table for p in plans),
+            use_filter=uf, use_sort=us)
 
     def _run_batch_f2(self, plans: list[ResidentPlan], k2: int,
                       n_sel: int):
@@ -1467,7 +1644,7 @@ class DeviceIndex:
         T = max(len(p.required) for p in plans)
         # two B buckets: the latency path (≤4 real queries) must not
         # pay a full B=bmax wave of [T, P, D] work for its pad lanes
-        B = 4 if len(plans) <= 4 else self._f2_bmax()
+        B = 4 if len(plans) <= 4 else max(self._f2_bmax(), len(plans))
 
         def pad_plan(p: ResidentPlan | None):
             if p is None:
@@ -1502,13 +1679,16 @@ class DeviceIndex:
         args = [np.stack([p[j] for p in padded]) for j in range(20)]
         log.debug("f2 wave: B=%d Rc=%d Rp=%d Lp=%d k2=%d n_sel=%d",
                   B, Rc, Rp, Lp, k2, n_sel)
+        d_filter, d_sort, uf, us = self._filter_sort_cols(plans[0])
         return _full_cube(
             self.d_payload, self.d_pdoc, self.d_pocc, self.d_cube,
             self.d_dense_rsp, self.d_siterank, self.d_doclang,
-            self.d_dead, np.int32(self.n_docs), *args,
+            self.d_dead, np.int32(self.n_docs), d_filter, d_sort,
+            *args,
             n_positions=self.P, lpost=Lp, k2=k2,
             n_sel=min(n_sel, self.D_cap),
-            use_table=any(p.has_table for p in plans))
+            use_table=any(p.has_table for p in plans),
+            use_filter=uf, use_sort=us)
 
     def _run_batch_fd(self, plans: list[ResidentPlan], k2: int,
                       n_sel: int):
@@ -1516,7 +1696,10 @@ class DeviceIndex:
         of the resident cube, small ones ride a bounded scatter tail —
         no per-query cube assembly."""
         T = max(len(p.required) for p in plans)
-        B = 4 if len(plans) <= 4 else 16
+        # FD intermediates are ~48·P·D bytes/query (same envelope as
+        # F2's cube+scoring chain) — cap B by the same HBM budget
+        B = 4 if len(plans) <= 4 else max(min(16, self._f2_bmax()),
+                                          len(plans))
         zq = 4 * getattr(self, "cube_zero_slot", 0)
         cs = np.full((B, T, 4), zq, np.int32)
         sy = np.zeros((B, T, 4), np.uint32)
@@ -1558,13 +1741,15 @@ class DeviceIndex:
         args = [np.stack([p[j] for p in padded]) for j in range(14)]
         log.debug("fd wave: B=%d T=%d Rp=%d Lp=%d k2=%d n_sel=%d",
                   B, T, Rp, Lp, k2, n_sel)
+        d_filter, d_sort, uf, us = self._filter_sort_cols(plans[0])
         return _direct_cube(
             self.d_cube, self.d_payload, self.d_pdoc, self.d_pocc,
             self.d_siterank, self.d_doclang, self.d_dead,
-            np.int32(self.n_docs), cs, sy, *args,
+            np.int32(self.n_docs), d_filter, d_sort, cs, sy, *args,
             n_positions=self.P, lpost=Lp, k2=k2,
             n_sel=min(n_sel, self.D_cap),
-            use_table=any(p.has_table for p in plans))
+            use_table=any(p.has_table for p in plans),
+            use_filter=uf, use_sort=us)
 
 
 @jax.jit
@@ -1573,14 +1758,17 @@ def _apply_doc_meta(sr, dl, idx, vsr, vdl):
 
 
 @partial(jax.jit, static_argnames=("n_positions", "lsp", "kappa", "k2",
-                                   "use_table"))
+                                   "use_table", "use_filter",
+                                   "use_sort"))
 def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
-               d_siterank, d_doclang, d_dead, n_docs_total, d_sel,
+               d_siterank, d_doclang, d_dead, n_docs_total,
+               d_filter, d_sort, d_sel,
                d_slot, d_group, d_base, d_quota, d_syn,
                s_start, s_len, s_group, s_base, s_quota, s_syn, s_isbase,
                freqw, required, negative, scored, counts, table, qlang,
                n_positions: int, lsp: int, kappa: int, k2: int,
-               use_table: bool = True):
+               use_table: bool = True, use_filter: bool = False,
+               use_sort: bool = False):
     """The fused two-phase kernel, vmapped over the query axis.
 
     Phase 1 = dense upper bounds + intersection + approx top-κ (the
@@ -1660,6 +1848,10 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
         tok = presence_table_ok(present, table) if use_table else True
         alive = (req_ok & neg_ok & tok
                  & (jnp.arange(D) < n_docs_total))
+        if use_filter:
+            # numeric range gate (gbmin:/gbmax: — a host-ANDed boolean
+            # column over however many fields the query constrained)
+            alive = alive & d_filter
         m1 = present & sc[:, None]
         ubw_m = jnp.where(m1, ubw, big)
         min_single_ub = jnp.min(ubw_m, axis=0)
@@ -1691,7 +1883,13 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
         # per-doc filter-only fallback (mirrors scorer.min_scores)
         ubmin = jnp.where(jnp.any(m1, axis=0), ubmin, 1.0)
         mult = final_multipliers(d_siterank, d_doclang, qlang)
-        ubfinal = jnp.where(alive, ubmin * mult * 1.00001, 0.0)
+        if use_sort:
+            # gbsortby: rank purely by the positive sort column — the
+            # per-doc "bound" IS the exact sort key, so selection is
+            # exact and the escalation check passes by construction
+            ubfinal = jnp.where(alive, d_sort, 0.0)
+        else:
+            ubfinal = jnp.where(alive, ubmin * mult * 1.00001, 0.0)
         nm = jnp.sum(alive)
 
         # candidate selection via top-8-per-block max-reduces:
@@ -1757,11 +1955,14 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
             else True
         match2 = (req_ok2 & neg_ok2 & tok2
                   & (cval > 0.0) & (min_sc < big))
-        final = jnp.where(
-            match2,
-            min_sc * final_multipliers(d_siterank[cand], d_doclang[cand],
-                                       qlang),
-            0.0)
+        if use_sort:
+            final = jnp.where(match2, d_sort[cand], 0.0)
+        else:
+            final = jnp.where(
+                match2,
+                min_sc * final_multipliers(d_siterank[cand],
+                                           d_doclang[cand], qlang),
+                0.0)
         ts, tl = jax.lax.top_k(final, k2)
         ti = cand[tl]
         return jnp.concatenate([
@@ -1779,14 +1980,17 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
 
 
 @partial(jax.jit, static_argnames=("n_positions", "lpost", "k2", "n_sel",
-                                   "use_table"))
+                                   "use_table", "use_filter",
+                                   "use_sort"))
 def _full_cube(d_payload, d_pdoc, d_pocc, d_cube, d_dense_rsp,
                d_siterank, d_doclang, d_dead, n_docs_total,
+               d_filter, d_sort,
                c_slot, c_dslot, c_group, c_base, c_quota, c_syn,
                p_start, p_len, p_group, p_base, p_quota, p_syn, p_isbase,
                freqw, required, negative, scored, counts, table, qlang,
                n_positions: int, lpost: int, k2: int, n_sel: int,
-               use_table: bool = True):
+               use_table: bool = True, use_filter: bool = False,
+               use_sort: bool = False):
     """Full-corpus exact kernel (F2) for corpus-wide drivers.
 
     Builds the [T, P, D] position cube over the WHOLE doc axis — the
@@ -1873,9 +2077,14 @@ def _full_cube(d_payload, d_pdoc, d_pocc, d_cube, d_dense_rsp,
         tok = presence_table_ok(present, table) if use_table else True
         match = (req_ok & neg_ok & tok
                  & (jnp.arange(D) < n_docs_total) & (min_sc < big))
-        final = jnp.where(
-            match, min_sc * final_multipliers(d_siterank, d_doclang,
-                                              qlang), 0.0)
+        if use_filter:
+            match = match & d_filter
+        if use_sort:
+            final = jnp.where(match, d_sort, 0.0)
+        else:
+            final = jnp.where(
+                match, min_sc * final_multipliers(d_siterank, d_doclang,
+                                                  qlang), 0.0)
         nm = jnp.sum(match)
         # block-winners then a cheap exact top-k over the winners;
         # escalation reruns with 4x the blocks, terminal at n_sel == D
@@ -1898,14 +2107,17 @@ def _full_cube(d_payload, d_pdoc, d_pocc, d_cube, d_dense_rsp,
 
 
 @partial(jax.jit, static_argnames=("n_positions", "lpost", "k2",
-                                   "n_sel", "use_table"))
+                                   "n_sel", "use_table", "use_filter",
+                                   "use_sort"))
 def _direct_cube(d_cube, d_payload, d_pdoc, d_pocc, d_siterank,
-                 d_doclang, d_dead, n_docs_total, g_quarter, g_qsyn,
+                 d_doclang, d_dead, n_docs_total, d_filter, d_sort,
+                 g_quarter, g_qsyn,
                  p_start, p_len, p_group, p_base, p_quota, p_syn,
                  p_isbase,
                  freqw, required, negative, scored, counts, table, qlang,
                  n_positions: int, lpost: int, k2: int, n_sel: int,
-                 use_table: bool = True):
+                 use_table: bool = True, use_filter: bool = False,
+                 use_sort: bool = False):
     """Direct full-corpus kernel (FD) — the F2 fast path for queries
     whose every group assembles from quarter-aligned base cube rows
     (1 sublist = full row; original+bigram = half+half;
@@ -1977,9 +2189,14 @@ def _direct_cube(d_cube, d_payload, d_pdoc, d_pocc, d_siterank,
         tok = presence_table_ok(present, table) if use_table else True
         match = (req_ok & neg_ok & tok
                  & (jnp.arange(D) < n_docs_total) & (min_sc < big))
-        final = jnp.where(
-            match, min_sc * final_multipliers(d_siterank, d_doclang,
-                                              qlang), 0.0)
+        if use_filter:
+            match = match & d_filter
+        if use_sort:
+            final = jnp.where(match, d_sort, 0.0)
+        else:
+            final = jnp.where(
+                match, min_sc * final_multipliers(d_siterank, d_doclang,
+                                                  qlang), 0.0)
         nm = jnp.sum(match)
         w_vals, w_idx, missed = _block_topn(final, min(n_sel, D))
         ts, tl = jax.lax.top_k(w_vals, min(k2, n_sel, D))

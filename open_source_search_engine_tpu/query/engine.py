@@ -51,6 +51,10 @@ class SearchResults:
     results: list[Result] = field(default_factory=list)
     clustered: int = 0  # results hidden by site clustering (Msg51)
     suggestion: str | None = None  # "did you mean" (Speller)
+    #: gbfacet: results — field → [(value, count)], counted over a
+    #: SAMPLE of the best-matching docs (the reference likewise
+    #: accumulates facets over the result sample, Msg40/PageResults)
+    facets: dict = field(default_factory=dict)
     #: True when a whole shard (every twin) was down and its documents
     #: are missing from this answer — the reference surfaces this on
     #: PageHosts; silent partial results are a correctness trap
@@ -240,7 +244,30 @@ def search(coll: Collection, q: str | QueryPlan, *, topk: int = 10,
     return SearchResults(
         query=raw, total_matches=total, results=page,
         clustered=clustered,
-        suggestion=_suggest(coll, plan) if total == 0 else None)
+        suggestion=_suggest(coll, plan) if total == 0 else None,
+        facets=compute_facets(
+            plan, docids[order],
+            lambda d: docproc.get_document(coll, docid=d)))
+
+
+#: facet sample size: facet counts come from the stored fields of the
+#: top FACET_SAMPLE matched docs (reference Msg40 samples its results)
+FACET_SAMPLE = 256
+
+
+def compute_facets(plan: QueryPlan, docids, get_doc) -> dict:
+    """field → [(value, count)] over a sample of matched docs."""
+    if not plan.facets:
+        return {}
+    from collections import Counter
+    counters = {f: Counter() for f in plan.facets}
+    for d in list(docids)[:FACET_SAMPLE]:
+        rec = get_doc(int(d))
+        flds = (rec or {}).get("fields") or {}
+        for f in plan.facets:
+            if f in flds:
+                counters[f][flds[f]] += 1
+    return {f: c.most_common(16) for f, c in counters.items()}
 
 
 def _suggest(coll: Collection, plan: QueryPlan) -> str | None:
@@ -293,7 +320,10 @@ def search_device_batch(coll: Collection, queries, *, topk: int = 10,
         out.append(SearchResults(
             query=plan.raw, total_matches=n_matched, results=page,
             clustered=clustered,
-            suggestion=_suggest(coll, plan) if n_matched == 0 else None))
+            suggestion=_suggest(coll, plan) if n_matched == 0 else None,
+            facets=compute_facets(
+                plan, docids,
+                lambda d: docproc.get_document(coll, docid=d))))
     g_stats.record_ms(
         "query.results_batch",
         1000 * (time.perf_counter() - t_res))

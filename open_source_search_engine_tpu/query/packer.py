@@ -119,6 +119,13 @@ class PackedQuery:
     doclang: np.ndarray       # int32 [D_pad]
     n_docs: int               # real candidate count (≤ D_pad)
     qlang: int
+    #: numeric-operator columns (gbmin/gbmax/gbsortby): filter mask and
+    #: positive sort keys over the candidate axis; flags gate the
+    #: kernel work (all-false/zero when absent)
+    filt: np.ndarray | None = None      # bool [D_pad]
+    sortc: np.ndarray | None = None     # float32 [D_pad]
+    use_filter: bool = False
+    use_sort: bool = False
 
     @property
     def shape_key(self) -> tuple[int, int, int]:
@@ -218,6 +225,10 @@ class PreparedQuery:
     driver: int               # -1 when cand is empty
     freq_weight: np.ndarray   # float32 [len(plan.groups)]
     unique_counts: np.ndarray  # int64 [len(plan.groups)] docs per group
+    #: per-candidate numeric-operator arrays (gbmin/gbmax/gbsortby),
+    #: None when the query has none
+    filt_all: np.ndarray | None = None
+    sort_all: np.ndarray | None = None
 
 
 def group_flags(plan: QueryPlan, T: int):
@@ -242,7 +253,57 @@ def group_flags(plan: QueryPlan, T: int):
     )
 
 
-def prepare_query(coll: Collection, plan: QueryPlan) -> PreparedQuery:
+def _field_values(coll: Collection, fld: str,
+                  cand: np.ndarray) -> np.ndarray:
+    """Per-candidate f64 field values (NaN = doc lacks the field) from
+    the fielddb column."""
+    docids, vals = coll.fielddb.column(fld)
+    out = np.full(len(cand), np.nan)
+    if len(docids):
+        pos = np.searchsorted(docids, cand)
+        ok = pos < len(docids)
+        ok[ok] = docids[pos[ok]] == cand[ok]
+        out[ok] = vals[pos[ok]]
+    return out
+
+
+def local_sort_base(coll: Collection, fld: str, desc: bool) -> float:
+    """This collection's minimum finite sort key (v desc, -v asc) —
+    the shift that keeps device sort keys positive. The SHARDED paths
+    take the min across shards so merged keys stay comparable."""
+    _, allvals = coll.fielddb.column(fld)
+    av = allvals if desc else -allvals
+    fin = np.isfinite(av)
+    return float(av[fin].min()) if fin.any() else 0.0
+
+
+def field_arrays(coll: Collection, plan: QueryPlan, cand: np.ndarray,
+                 sort_base: float | None = None):
+    """(filt, sortc) candidate arrays for the numeric operators. Sort
+    keys shift by ``sort_base`` (callers pass the cross-shard minimum
+    on sharded paths; None = this collection's own minimum) so every
+    path emits identical, merge-comparable scores."""
+    filt = sortc = None
+    if plan.filters:
+        filt = np.ones(len(cand), bool)
+        for fld, (lo, hi) in plan.filters.items():
+            dv = _field_values(coll, fld, cand)
+            with np.errstate(invalid="ignore"):
+                filt &= (dv >= lo) & (dv <= hi)  # NaN fails both
+    if plan.sortby is not None:
+        fld, desc = plan.sortby
+        dv = _field_values(coll, fld, cand)
+        key = dv if desc else -dv
+        base = sort_base if sort_base is not None \
+            else local_sort_base(coll, fld, desc)
+        finite = np.isfinite(key)
+        sortc = np.where(finite, key - base + 1.0,
+                         0.25).astype(np.float32)
+    return filt, sortc
+
+
+def prepare_query(coll: Collection, plan: QueryPlan,
+                  sort_base: float | None = None) -> PreparedQuery:
     """Fetch termlists, pick the driver, intersect candidates.
 
     ``cand`` comes back empty when no doc can match (an empty required
@@ -275,10 +336,12 @@ def prepare_query(coll: Collection, plan: QueryPlan) -> PreparedQuery:
             else np.empty(0, np.uint64))
         driver = (max(range(len(lists)), key=lambda i: len(uniques[i]))
                   if lists else -1)
+        fa, sa = field_arrays(coll, plan, cand, sort_base=sort_base)
         return PreparedQuery(plan=plan, lists=lists, cand=cand,
                              driver=driver if len(cand) else -1,
                              freq_weight=freqw,
-                             unique_counts=unique_counts)
+                             unique_counts=unique_counts,
+                             filt_all=fa, sort_all=sa)
 
     if not req or any(not len(uniques[i]) for i in req):
         return PreparedQuery(plan=plan, lists=lists,
@@ -294,8 +357,10 @@ def prepare_query(coll: Collection, plan: QueryPlan) -> PreparedQuery:
     for i in req:
         if i != driver and len(cand):
             cand = cand[np.isin(cand, uniques[i], assume_unique=True)]
+    fa, sa = field_arrays(coll, plan, cand, sort_base=sort_base)
     return PreparedQuery(plan=plan, lists=lists, cand=cand, driver=driver,
-                         freq_weight=freqw, unique_counts=unique_counts)
+                         freq_weight=freqw, unique_counts=unique_counts,
+                         filt_all=fa, sort_all=sa)
 
 
 def pack_pass(prep: PreparedQuery, doc_offset: int = 0,
@@ -395,6 +460,13 @@ def pack_pass(prep: PreparedQuery, doc_offset: int = 0,
         if plan.bool_table is None:
             break  # driver covers every candidate in conjunctive mode
 
+    filt = sortc = None
+    if prep.filt_all is not None:
+        filt = np.zeros(D_pad, bool)
+        filt[:D] = prep.filt_all[doc_offset:doc_offset + D]
+    if prep.sort_all is not None:
+        sortc = np.zeros(D_pad, np.float32)
+        sortc[:D] = prep.sort_all[doc_offset:doc_offset + D]
     return PackedQuery(
         doc_idx=doc_idx, payload=payload, slot=slot, valid=valid,
         freq_weight=_pad1(prep.freq_weight, T, 0.5),
@@ -402,7 +474,9 @@ def pack_pass(prep: PreparedQuery, doc_offset: int = 0,
         counts=counts, table=pad_table(plan.bool_table),
         cand_docids=cand,
         siterank=siterank, doclang=doclang,
-        n_docs=D, qlang=plan.lang)
+        n_docs=D, qlang=plan.lang,
+        filt=filt, sortc=sortc,
+        use_filter=filt is not None, use_sort=sortc is not None)
 
 
 def pack_query(coll: Collection, plan: QueryPlan,

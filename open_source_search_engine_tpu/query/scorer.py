@@ -248,7 +248,8 @@ def presence_table_ok(present, table):
 
 def score_cube(cube, pvalid, freq_weight, required, negative, scored,
                counts, table, siterank, doclang, qlang, n_docs,
-               topk: int = 64):
+               topk: int = 64, filt=None, sortc=None,
+               use_filter: bool = False, use_sort: bool = False):
     """Score the dense position cube — the docIdLoop replacement.
 
     Shapes: cube/pvalid [T, P, D] (doc axis minor);
@@ -267,9 +268,15 @@ def score_cube(cube, pvalid, freq_weight, required, negative, scored,
     in_range = jnp.arange(D) < n_docs
     match = (req_ok & neg_ok & presence_table_ok(present, table)
              & in_range & (min_score < big))
-
-    final = min_score * final_multipliers(siterank, doclang, qlang)
-    final = jnp.where(match, final, 0.0)
+    if use_filter:
+        # numeric range gate (gbmin:/gbmax: over fielddb columns)
+        match = match & filt
+    if use_sort:
+        # gbsortby: the positive sort key IS the ranking score
+        final = jnp.where(match, sortc, 0.0)
+    else:
+        final = min_score * final_multipliers(siterank, doclang, qlang)
+        final = jnp.where(match, final, 0.0)
 
     k = min(topk, D)
     top_scores, top_idx = jax.lax.top_k(final, k)
@@ -280,7 +287,9 @@ def score_cube(cube, pvalid, freq_weight, required, negative, scored,
 def score_core(doc_idx, payload, slot, valid, freq_weight, required,
                negative, scored, counts, table, siterank, doclang,
                qlang, n_docs,
-               n_positions: int = MAX_POSITIONS, topk: int = 64):
+               n_positions: int = MAX_POSITIONS, topk: int = 64,
+               filt=None, sortc=None, use_filter: bool = False,
+               use_sort: bool = False):
     """Host-packed entry: scatter rows (1 row = 1 group) then score.
     Pure traced function — called under plain jit for the single-shard
     path and inside ``shard_map`` for the mesh path."""
@@ -288,19 +297,24 @@ def score_core(doc_idx, payload, slot, valid, freq_weight, required,
                                 siterank.shape[0], n_positions)
     return score_cube(cube, pvalid, freq_weight, required, negative,
                       scored, counts, table, siterank, doclang, qlang,
-                      n_docs, topk=topk)
+                      n_docs, topk=topk, filt=filt, sortc=sortc,
+                      use_filter=use_filter, use_sort=use_sort)
 
 
 score_and_topk = jax.jit(score_core, static_argnames=("n_positions", "topk"))
 
 
-def _score_packed_out(*args, n_positions: int, topk: int):
+def _score_packed_out(*args, n_positions: int, topk: int,
+                      use_filter: bool = False, use_sort: bool = False):
     """score_core with the three outputs packed into ONE uint32 vector:
     ``[n_matched, top_idx…, bitcast(top_scores)…]``. A device→host fetch
     costs a full RPC round trip on tunneled TPU backends (~50 ms each,
     not batched by device_get), so one output array = one round trip."""
-    n_matched, ts, ti = score_core(*args, n_positions=n_positions,
-                                   topk=topk)
+    *core_args, filt, sortc = args
+    n_matched, ts, ti = score_core(*core_args, n_positions=n_positions,
+                                   topk=topk, filt=filt, sortc=sortc,
+                                   use_filter=use_filter,
+                                   use_sort=use_sort)
     return jnp.concatenate([
         jnp.atleast_1d(n_matched.astype(jnp.uint32)),
         ti.astype(jnp.uint32),
@@ -309,7 +323,8 @@ def _score_packed_out(*args, n_positions: int, topk: int):
 
 
 _score_packed = jax.jit(_score_packed_out,
-                        static_argnames=("n_positions", "topk"))
+                        static_argnames=("n_positions", "topk",
+                                         "use_filter", "use_sort"))
 
 
 def run_query(pq: PackedQuery, topk: int = 64):
@@ -317,13 +332,18 @@ def run_query(pq: PackedQuery, topk: int = 64):
     k = min(topk, len(pq.siterank))
     # one batched device_put: per-arg implicit transfers each pay the
     # tunnel RPC overhead; a single list transfer is ~10× cheaper
+    dpad = len(pq.siterank)
+    filt = pq.filt if pq.filt is not None else np.zeros(dpad, bool)
+    sortc = pq.sortc if pq.sortc is not None \
+        else np.zeros(dpad, np.float32)
     dev = jax.device_put([
         pq.doc_idx, pq.payload, pq.slot, pq.valid, pq.freq_weight,
         pq.required, pq.negative, pq.scored, pq.counts, pq.table,
         pq.siterank, pq.doclang,
-        np.int32(pq.qlang), np.int32(pq.n_docs)])
+        np.int32(pq.qlang), np.int32(pq.n_docs), filt, sortc])
     out = np.asarray(_score_packed(
-        *dev, n_positions=MAX_POSITIONS, topk=topk))
+        *dev, n_positions=MAX_POSITIONS, topk=topk,
+        use_filter=pq.use_filter, use_sort=pq.use_sort))
     n_matched = int(out[0])
     top_idx = out[1:1 + k].astype(np.int64)
     top_scores = out[1 + k:].view(np.float32)

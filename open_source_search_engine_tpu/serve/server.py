@@ -147,6 +147,8 @@ def render_results(res: engine.SearchResults, fmt: str) -> tuple[str, str]:
             "totalMatches": res.total_matches,
             "clustered": res.clustered,
             "suggestion": res.suggestion,
+            "facets": {f: [[v, c] for v, c in pairs]
+                       for f, pairs in (res.facets or {}).items()},
             "results": [
                 {"docId": r.docid, "score": r.score, "url": r.url,
                  "title": r.title, "snippet": r.snippet, "site": r.site}

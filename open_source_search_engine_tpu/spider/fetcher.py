@@ -14,6 +14,7 @@ performance envelope.
 
 from __future__ import annotations
 
+import re
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -71,6 +72,36 @@ class RobotsCache:
         return rp.can_fetch(USER_AGENT, url)
 
 
+_META_CHARSET_RE = re.compile(
+    rb"""<meta[^>]+charset\s*=\s*["']?([a-zA-Z0-9_\-]+)""",
+    re.IGNORECASE)
+
+
+def sniff_charset(data: bytes, declared: str | None) -> str:
+    """Charset resolution (the iana_charset.cpp role): HTTP header >
+    BOM > <meta charset> / http-equiv sniff over the head bytes >
+    utf-8 fallback. Unknown names fall back to utf-8-with-replace at
+    decode time (codecs.lookup gate)."""
+    import codecs
+    cand = declared
+    if not cand:
+        if data[:3] == b"\xef\xbb\xbf":
+            cand = "utf-8"
+        elif data[:2] in (b"\xff\xfe", b"\xfe\xff"):
+            cand = "utf-16"
+        else:
+            m = _META_CHARSET_RE.search(data[:4096])
+            if m:
+                cand = m.group(1).decode("ascii", "replace")
+    if cand:
+        try:
+            codecs.lookup(cand)
+            return cand
+        except LookupError:
+            pass
+    return "utf-8"
+
+
 def _gunzip_capped(data: bytes) -> bytes:
     """Decompress at most MAX_DOC_BYTES of output — a gzip bomb must not
     defeat the download cap (the reference likewise bounds doc length
@@ -89,18 +120,52 @@ def _raw_get(url: str, timeout: float = 10.0) -> str:
             r.headers.get_content_charset() or "utf-8", "replace")
 
 
+class ResponseCache:
+    """TTL'd url → FetchResult cache (Msg13's response cache,
+    ``Msg13.h:168`` — repeated fetches of one url within the TTL serve
+    from cache instead of re-hammering the site). Bounded LRU-ish."""
+
+    def __init__(self, ttl_s: float = 3600.0, max_entries: int = 1024):
+        self.ttl_s = ttl_s
+        self.max_entries = max_entries
+        self._d: dict[str, tuple[float, FetchResult]] = {}
+
+    def get(self, url: str) -> FetchResult | None:
+        import time
+        hit = self._d.get(url)
+        if hit is None or hit[0] < time.monotonic():
+            return None
+        return hit[1]
+
+    def put(self, url: str, res: FetchResult) -> None:
+        import time
+        if len(self._d) >= self.max_entries:
+            # drop the stalest half (cheap, rare)
+            for k in sorted(self._d, key=lambda k: self._d[k][0])[
+                    : self.max_entries // 2]:
+                del self._d[k]
+        self._d[url] = (time.monotonic() + self.ttl_s, res)
+
+
 class Fetcher:
     """Parallel robots-aware downloader."""
 
     def __init__(self, n_threads: int = 8, timeout: float = 10.0,
-                 respect_robots: bool = True):
+                 respect_robots: bool = True,
+                 cache_ttl_s: float = 3600.0):
         self.pool = ThreadPoolExecutor(max_workers=n_threads,
                                        thread_name_prefix="fetch")
         self.timeout = timeout
         self.respect_robots = respect_robots
         self.robots = RobotsCache()
+        self.cache = ResponseCache(ttl_s=cache_ttl_s) \
+            if cache_ttl_s > 0 else None
 
     def fetch_one(self, url: str) -> FetchResult:
+        if self.cache is not None:
+            hit = self.cache.get(url)
+            if hit is not None:
+                return hit
         if self.respect_robots and not self.robots.allowed(url):
             return FetchResult(url=url, status=999, error="robots.txt")
         req = urllib.request.Request(url, headers={
@@ -110,11 +175,15 @@ class Fetcher:
                 data = r.read(MAX_DOC_BYTES)
                 if r.headers.get("Content-Encoding") == "gzip":
                     data = _gunzip_capped(data)
-                charset = r.headers.get_content_charset() or "utf-8"
-                return FetchResult(
+                charset = sniff_charset(
+                    data, r.headers.get_content_charset())
+                res = FetchResult(
                     url=r.url, status=r.status,
                     content=data.decode(charset, "replace"),
                     content_type=r.headers.get_content_type())
+                if self.cache is not None and res.ok:
+                    self.cache.put(url, res)
+                return res
         except urllib.error.HTTPError as e:
             return FetchResult(url=url, status=e.code, error=str(e))
         except Exception as e:  # noqa: BLE001 — network errors are data

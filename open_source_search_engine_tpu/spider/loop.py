@@ -86,6 +86,11 @@ class SpiderLoop:
         indexed = 0
         mark_done = getattr(self.sched, "mark_done", None)
         for req, res in zip(batch, results):
+            # ALWAYS release the IP's in-flight lock — politeness
+            # windows start from fetch completion (per-IP discipline)
+            release = getattr(self.sched, "release", None)
+            if release is not None:
+                release(req.url, first_ip=req.first_ip or None)
             if mark_done is not None and not (
                     res.status == 0 or res.status == 999
                     or 500 <= res.status < 600):
@@ -93,7 +98,7 @@ class SpiderLoop:
                 # (success or permanent 4xx); network errors, 5xx, and
                 # robots blocks stay unreplied so the url re-doles on a
                 # later crawl (the reference schedules error retries)
-                mark_done(req.url)
+                mark_done(req.url, first_ip=req.first_ip or None)
             self.stats.fetched += 1
             self.stats.by_status[res.status] = \
                 self.stats.by_status.get(res.status, 0) + 1

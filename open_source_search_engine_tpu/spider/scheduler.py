@@ -60,6 +60,7 @@ class _Doled:
     url: str = field(compare=False)
     hopcount: int = field(compare=False)
     priority: int = field(compare=False)
+    first_ip: str = field(compare=False, default="")
 
 
 @dataclass
@@ -68,6 +69,7 @@ class SpiderRequest:
     hopcount: int = 0
     priority: int = 0
     added: float = 0.0
+    first_ip: str = ""
 
 
 class SpiderScheduler:
@@ -75,7 +77,7 @@ class SpiderScheduler:
 
     def __init__(self, filters: list[UrlFilterRule] | None = None,
                  max_hops: int = 3, same_host_only: bool = False,
-                 banned=None):
+                 banned=None, resolver=None):
         self.filters = filters or list(DEFAULT_FILTERS)
         self.max_hops = max_hops
         self.same_host_only = same_host_only
@@ -83,19 +85,36 @@ class SpiderScheduler:
         #: sites never enter the frontier (the reference's urlfilters
         #: consult tagdb's manualban before doling)
         self.banned = banned
+        #: host → first-IP (the reference keys EVERYTHING by firstIP,
+        #: Spider.h:99-108); injectable for tests/offline crawls
+        self.resolver = resolver
         self.seen: set[int] = set()          # urlhash48 (spider replies)
         self.heap: list[_Doled] = []         # doledb
-        self.host_ready_at: dict[str, float] = {}  # per-host politeness
-        self.host_delay: dict[str, float] = {}
+        #: per-IP politeness + in-flight locks: two hosts behind one IP
+        #: share a window, and an IP with a fetch IN FLIGHT never doles
+        #: again until mark_done releases it — the doledb-lock (0x12)
+        #: role, lock-free because one scheduler owns each IP
+        self.ip_ready_at: dict[str, float] = {}
+        self.ip_delay: dict[str, float] = {}
+        self.ip_inflight: set[str] = set()
         self.roots: set[str] = set()         # seed hosts for same_host_only
         self.n_added = 0
         self.n_doled = 0
 
+    def _ip_of(self, host: str) -> str:
+        from ..utils import ipresolve
+        if self.resolver is not None:
+            return self.resolver(host)
+        return ipresolve.first_ip(host)
+
     # --- adds (spiderdb writes) ---
 
-    def add_url(self, url: str, hopcount: int = 0) -> bool:
+    def add_url(self, url: str, hopcount: int = 0,
+                _ip: str | None = None) -> bool:
         """Queue a url if filters allow and it hasn't been seen
-        (``SpiderRequest`` add → waiting tree)."""
+        (``SpiderRequest`` add → waiting tree). ``_ip`` short-circuits
+        resolution when the caller already knows the first-IP (durable
+        reloads replay stored IPs)."""
         try:
             u = normalize(url)
         except Exception:
@@ -118,12 +137,15 @@ class SpiderScheduler:
         if hopcount == 0:
             self.roots.add(u.host)
         self.seen.add(h)
-        self.host_delay.setdefault(u.host, rule.delay_s)
+        ip = _ip if _ip is not None else self._ip_of(u.host)
+        self.last_added_ip = ip
+        self.ip_delay.setdefault(ip, rule.delay_s)
         # lower sort key pops first: (-priority, hopcount, arrival)
         self.n_added += 1
         heapq.heappush(self.heap, _Doled(
             sort_key=(-rule.priority, hopcount, self.n_added),
-            url=u.full, hopcount=hopcount, priority=rule.priority))
+            url=u.full, hopcount=hopcount, priority=rule.priority,
+            first_ip=ip))
         return True
 
     def _rule_for(self, url: str) -> UrlFilterRule | None:
@@ -136,24 +158,50 @@ class SpiderScheduler:
 
     def next_batch(self, n: int, now: float | None = None
                    ) -> list[SpiderRequest]:
-        """Pop up to n urls whose hosts are past their politeness window
-        (SpiderLoop::spiderDoledUrls + per-IP wait semantics)."""
+        """Pop up to n urls whose FIRST-IPs are past their politeness
+        window and not in flight (SpiderLoop::spiderDoledUrls + the
+        per-IP wait tree; in-flight exclusion is the doledb-lock role —
+        an IP is never fetched concurrently, even across hosts)."""
         now = time.monotonic() if now is None else now
         out: list[SpiderRequest] = []
         requeue: list[_Doled] = []
+        batch_ips: set[str] = set()
         while self.heap and len(out) < n:
             d = heapq.heappop(self.heap)
-            host = normalize(d.url).host
-            if self.host_ready_at.get(host, 0.0) > now:
+            ip = d.first_ip or self._ip_of(normalize(d.url).host)
+            if (ip in self.ip_inflight or ip in batch_ips
+                    or self.ip_ready_at.get(ip, 0.0) > now):
                 requeue.append(d)
                 continue
-            self.host_ready_at[host] = now + self.host_delay.get(host, 0.25)
+            batch_ips.add(ip)
+            self.ip_inflight.add(ip)
             self.n_doled += 1
             out.append(SpiderRequest(url=d.url, hopcount=d.hopcount,
-                                     priority=d.priority, added=now))
+                                     priority=d.priority, added=now,
+                                     first_ip=ip))
         for d in requeue:
             heapq.heappush(self.heap, d)
         return out
+
+    def release(self, url: str, now: float | None = None,
+                first_ip: str | None = None) -> None:
+        """Fetch attempt finished (any outcome): release the IP's
+        in-flight lock and start its politeness window FROM COMPLETION
+        (the reference waits spiderDelay from the reply, not the dole).
+
+        ``first_ip`` should be the IP the request was DOLED under
+        (SpiderRequest.first_ip): re-resolving here could return a
+        different IP after a TTL lapse and leave the original
+        in-flight entry locked forever."""
+        now = time.monotonic() if now is None else now
+        ip = first_ip
+        if not ip:
+            try:
+                ip = self._ip_of(normalize(url).host)
+            except Exception:
+                return
+        self.ip_inflight.discard(ip)
+        self.ip_ready_at[ip] = now + self.ip_delay.get(ip, 0.25)
 
     def __len__(self) -> int:
         return len(self.heap)

@@ -6,10 +6,12 @@ IP's urls (IP-hash sharding, ``Hostdb.cpp:~2526``); doledb is the
 derived ready-queue view (``Spider.h:982``). The round-2 verdict's
 words: "a crawl at reference scale cannot live in a Python heap".
 
-Ours: a 16-byte key — ``n1 = hosthash32<<32 | urlhash_hi32`` (host hash
-plays the firstIP role: all of a host's urls colocate, politeness and
-sharding are host-granular), ``n0 = urlhash_lo31<<2 | type<<1 |
-delbit`` — with a JSON payload for requests. Two record types at the
+Ours: a 16-byte key — ``n1 = iphash32<<32 | urlhash_hi32`` (the hash
+of the url host's RESOLVED first-IP, ``utils.ipresolve`` — all of an
+IP's urls colocate, so politeness and sharding are IP-granular exactly
+like the reference), ``n0 = urlhash_lo31<<2 | type<<1 | delbit`` — with
+a JSON payload for requests that also records the resolved IP (reloads
+never re-resolve). Two record types at the
 same (host, url): REQUEST (the frontier entry, written when a url is
 queued) and REPLY (written when the fetch completed — the dedup
 witness). The surviving frontier = requests without a reply, computed
@@ -47,16 +49,23 @@ TYPE_REQUEST = 0
 TYPE_REPLY = 1
 
 
-def _hosthash32(host: str) -> int:
-    return ghash.hash64(host) & 0xFFFFFFFF
+def _iphash32(ip: str) -> int:
+    return ghash.hash64(ip) & 0xFFFFFFFF
 
 
-def shard_of_url(url: str, n_shards: int) -> int:
-    """Owning shard for a url's frontier entry — host-hash routed, the
-    reference's firstIP sharding (Hostdb.cpp:~2526)."""
-    u = normalize(url)
+def first_ip_of(url: str, resolver=None) -> str:
+    from ..utils import ipresolve
+    host = normalize(url).host
+    return resolver(host) if resolver is not None \
+        else ipresolve.first_ip(host)
+
+
+def shard_of_url(url: str, n_shards: int, resolver=None) -> int:
+    """Owning shard for a url's frontier entry — FIRST-IP routed, the
+    reference's firstIP sharding (Hostdb.cpp:~2526): one shard owns an
+    IP's whole queue, so per-IP politeness needs no cluster locks."""
     return int(ghash.hash64_array(
-        np.asarray([_hosthash32(u.host)], np.uint64))[0]
+        np.asarray([_iphash32(first_ip_of(url, resolver))], np.uint64))[0]
         % np.uint64(n_shards))
 
 
@@ -66,11 +75,14 @@ def urlhash63(url_full: str) -> int:
     return ghash.hash64(url_full) >> 1
 
 
-def pack_key(url: str, rec_type: int) -> np.ndarray:
+def pack_key(url: str, rec_type: int, first_ip: str | None = None,
+             resolver=None) -> np.ndarray:
     u = normalize(url)
     uh = urlhash63(u.full)
+    ip = first_ip if first_ip is not None \
+        else first_ip_of(url, resolver)
     k = np.zeros((), dtype=KEY_DTYPE)
-    k["n1"] = np.uint64((_hosthash32(u.host) << 32) | (uh >> 31))
+    k["n1"] = np.uint64((_iphash32(ip) << 32) | (uh >> 31))
     k["n0"] = np.uint64(((uh & 0x7FFFFFFF) << 2)
                         | ((rec_type & 1) << 1) | 1)
     return k
@@ -78,7 +90,7 @@ def pack_key(url: str, rec_type: int) -> np.ndarray:
 
 def unpack_keys(keys: np.ndarray):
     return {
-        "hosthash": (keys["n1"] >> np.uint64(32)).astype(np.uint64),
+        "iphash": (keys["n1"] >> np.uint64(32)).astype(np.uint64),
         "urlhash": (((keys["n1"] & np.uint64(0xFFFFFFFF))
                      << np.uint64(31))
                     | ((keys["n0"] >> np.uint64(2))
@@ -112,10 +124,12 @@ class SpiderDb:
             try:
                 rec = json.loads(line)
                 if rec["t"] == TYPE_REPLY:
-                    self.add_reply(rec["u"], _journal=False)
+                    self.add_reply(rec["u"], first_ip=rec.get("ip"),
+                                   _journal=False)
                 else:
                     self.add_request(rec["u"], rec.get("h", 0),
                                      rec.get("p", 0), rec.get("s", 0),
+                                     first_ip=rec.get("ip"),
                                      _journal=False)
             except Exception:  # noqa: BLE001 — torn tail line
                 continue
@@ -127,18 +141,24 @@ class SpiderDb:
         os.fsync(self._journal.fileno())
 
     def add_request(self, url: str, hopcount: int, priority: int,
-                    seq: int, _journal: bool = True) -> None:
+                    seq: int, first_ip: str | None = None,
+                    _journal: bool = True) -> None:
         if _journal:
             self._journal_write({"t": TYPE_REQUEST, "u": url,
-                                 "h": hopcount, "p": priority, "s": seq})
+                                 "h": hopcount, "p": priority, "s": seq,
+                                 "ip": first_ip})
         payload = json.dumps({"u": url, "h": hopcount, "p": priority,
-                              "s": seq}).encode()
-        self.rdb.add(pack_key(url, TYPE_REQUEST).reshape(1), [payload])
+                              "s": seq, "ip": first_ip}).encode()
+        self.rdb.add(pack_key(url, TYPE_REQUEST,
+                              first_ip=first_ip).reshape(1), [payload])
 
-    def add_reply(self, url: str, _journal: bool = True) -> None:
+    def add_reply(self, url: str, first_ip: str | None = None,
+                  _journal: bool = True) -> None:
         if _journal:
-            self._journal_write({"t": TYPE_REPLY, "u": url})
-        self.rdb.add(pack_key(url, TYPE_REPLY).reshape(1), [b"{}"])
+            self._journal_write({"t": TYPE_REPLY, "u": url,
+                                 "ip": first_ip})
+        self.rdb.add(pack_key(url, TYPE_REPLY,
+                              first_ip=first_ip).reshape(1), [b"{}"])
 
     def load(self):
         """One merged scan → (pending requests, seen urlhashes).
@@ -186,34 +206,39 @@ class DurableSpiderScheduler(SpiderScheduler):
     def __init__(self, directory: str | Path,
                  filters: list[UrlFilterRule] | None = None,
                  max_hops: int = 3, same_host_only: bool = False,
-                 banned=None):
+                 banned=None, resolver=None):
         super().__init__(filters=filters, max_hops=max_hops,
-                         same_host_only=same_host_only, banned=banned)
+                         same_host_only=same_host_only, banned=banned,
+                         resolver=resolver)
         self.db = SpiderDb(directory)
         pending, seen = self.db.load()
         #: url identities already in spiderdb (63-bit key hash — the
         #: base class's in-RAM seen-set uses a different hash width)
         self._seen63 = {int(x) for x in seen}
-        # replay in original arrival order so priorities/tiebreaks hold
+        # replay in original arrival order so priorities/tiebreaks
+        # hold; stored IPs replay verbatim (no re-resolution)
         for rec in sorted(pending, key=lambda r: r.get("s", 0)):
-            super().add_url(rec["u"], hopcount=rec.get("h", 0))
+            super().add_url(rec["u"], hopcount=rec.get("h", 0),
+                            _ip=rec.get("ip"))
 
-    def add_url(self, url: str, hopcount: int = 0) -> bool:
+    def add_url(self, url: str, hopcount: int = 0,
+                _ip: str | None = None) -> bool:
         try:
             uh = urlhash63(normalize(url).full)
         except Exception:
             return False
         if uh in self._seen63:
             return False
-        ok = super().add_url(url, hopcount=hopcount)
+        ok = super().add_url(url, hopcount=hopcount, _ip=_ip)
         if ok:
             self._seen63.add(uh)
-            self.db.add_request(url, hopcount, 0, self.n_added)
+            self.db.add_request(url, hopcount, 0, self.n_added,
+                                first_ip=self.last_added_ip)
         return ok
 
-    def mark_done(self, url: str) -> None:
+    def mark_done(self, url: str, first_ip: str | None = None) -> None:
         """The SpiderReply write: this url never re-doles."""
-        self.db.add_reply(url)
+        self.db.add_reply(url, first_ip=first_ip)
 
     def checkpoint(self) -> None:
         self.db.checkpoint()

@@ -1,0 +1,98 @@
+"""First-IP resolution — the crawl plane's DNS seam.
+
+Reference: the spider keys everything by **firstIP** (the first A record
+of a url's host): spiderdb records (``Spider.h:99-108``), shard
+ownership (``Hostdb.cpp:~2526``) and per-IP politeness all hang off it.
+The reference runs its own recursive resolver (``Dns.cpp``, 3.1k LoC —
+root→TLD walk, RdbCache-backed, in-flight dedup); here the OS resolver
+does the walk and this module supplies the pieces the crawler needs
+around it: a TTL cache, in-flight dedup (concurrent lookups of one host
+collapse into one), an injectable resolver for tests/air-gapped runs,
+and a deterministic fallback pseudo-IP when resolution fails — so
+sharding and politeness stay stable even offline (every scheduler maps
+an unresolvable host to the same pseudo-IP).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+from . import ghash
+
+#: resolution cache TTL (the reference caches DNS in an RdbCache with
+#: its own TTL; 1h matches its default dns cache behavior)
+TTL_S = 3600.0
+
+_cache: dict[str, tuple[str, float]] = {}
+_inflight: dict[str, threading.Event] = {}
+_lock = threading.Lock()
+
+#: test/offline hook: set to a callable host → ip-string
+resolver_override = None
+
+
+def _pseudo_ip(host: str) -> str:
+    """Deterministic fallback for unresolvable hosts: a reserved-range
+    pseudo-IP derived from the host hash. Sharding and politeness stay
+    consistent cluster-wide (every node derives the same value); the
+    0.x.x.x prefix can never collide with a real routable first-IP."""
+    h = ghash.hash64(host)
+    return f"0.{(h >> 16) & 0xFF}.{(h >> 8) & 0xFF}.{h & 0xFF}"
+
+
+def first_ip(host: str, timeout: float = 5.0) -> str:
+    """The host's first A record, TTL-cached, lookup-deduped."""
+    now = time.monotonic()
+    with _lock:
+        hit = _cache.get(host)
+        if hit is not None and hit[1] > now:
+            return hit[0]
+        ev = _inflight.get(host)
+        if ev is None:
+            ev = _inflight[host] = threading.Event()
+            owner = True
+        else:
+            owner = False
+    if not owner:
+        ev.wait(timeout)
+        with _lock:
+            hit = _cache.get(host)
+        return hit[0] if hit is not None else _pseudo_ip(host)
+    try:
+        if resolver_override is not None:
+            ip = resolver_override(host)
+        else:
+            # getaddrinfo has no timeout parameter and can hang for
+            # minutes on a broken resolver path — bound it with a
+            # daemon thread (the reference's Dns.cpp owns its own UDP
+            # timeouts; riding the OS resolver costs us this dance)
+            box: list[str] = []
+
+            def _lookup() -> None:
+                try:
+                    box.append(socket.getaddrinfo(
+                        host, None, family=socket.AF_INET,
+                        type=socket.SOCK_STREAM)[0][4][0])
+                except Exception:  # noqa: BLE001
+                    pass
+            t = threading.Thread(target=_lookup, daemon=True)
+            t.start()
+            t.join(timeout)
+            ip = box[0] if box else _pseudo_ip(host)
+    except Exception:  # noqa: BLE001 — unresolvable host
+        ip = _pseudo_ip(host)
+    with _lock:
+        if len(_cache) > 65536:
+            _cache.clear()
+        _cache[host] = (ip, now + TTL_S)
+        _inflight.pop(host, None)
+    ev.set()
+    return ip
+
+
+def clear_cache() -> None:
+    with _lock:
+        _cache.clear()
+        _inflight.clear()

@@ -1,7 +1,21 @@
-"""Language identification (reference: ``Language.cpp``/``LanguageIdentifier.cpp``
-~8k LoC of charset+dictionary scoring; ours is a compact stopword-profile
-scorer — same contract: text → langId used in posdb keys and same-language
-query boost (``Posdb.cpp`` SAMELANGMULT))."""
+"""Language identification (reference: ``Language.cpp``/
+``LanguageIdentifier.cpp`` ~8k LoC of charset+dictionary scoring).
+
+Two data-free signal families, layered like the reference's scorer:
+
+1. **Script detection** (Unicode block histogram over the raw
+   characters) — decisive for non-Latin languages: Cyrillic → ru,
+   Greek → el, Hebrew → he, Arabic → ar, Thai → th, Devanagari → hi,
+   Hangul → ko, kana → ja, and Han-without-kana → zh. The reference
+   leans on charset hints the same way; code-point ranges are the
+   charset-independent form.
+2. **Stopword profiles** for Latin-script languages (en/fr/es/de/it/
+   pt/nl/sv/pl/tr) — distinct-stopword scoring normalized by profile
+   size so long profiles don't dominate.
+
+Contract unchanged: text/tokens → langId packed into posdb keys and
+used by the same-language query boost (``Posdb.cpp`` SAMELANGMULT).
+"""
 
 from __future__ import annotations
 
@@ -16,11 +30,26 @@ LANG_ITALIAN = 5
 LANG_PORTUGUESE = 6
 LANG_DUTCH = 7
 LANG_RUSSIAN = 8
+LANG_JAPANESE = 9
+LANG_CHINESE = 10
+LANG_KOREAN = 11
+LANG_ARABIC = 12
+LANG_HEBREW = 13
+LANG_GREEK = 14
+LANG_THAI = 15
+LANG_HINDI = 16
+LANG_SWEDISH = 17
+LANG_POLISH = 18
+LANG_TURKISH = 19
 
 LANG_NAMES = {
     LANG_UNKNOWN: "xx", LANG_ENGLISH: "en", LANG_FRENCH: "fr",
     LANG_SPANISH: "es", LANG_GERMAN: "de", LANG_ITALIAN: "it",
     LANG_PORTUGUESE: "pt", LANG_DUTCH: "nl", LANG_RUSSIAN: "ru",
+    LANG_JAPANESE: "ja", LANG_CHINESE: "zh", LANG_KOREAN: "ko",
+    LANG_ARABIC: "ar", LANG_HEBREW: "he", LANG_GREEK: "el",
+    LANG_THAI: "th", LANG_HINDI: "hi", LANG_SWEDISH: "sv",
+    LANG_POLISH: "pl", LANG_TURKISH: "tr",
 }
 LANG_IDS = {v: k for k, v in LANG_NAMES.items()}
 
@@ -31,39 +60,105 @@ _PROFILES: dict[int, frozenset[str]] = {
         "there on it at by but be or as we".split()),
     LANG_FRENCH: frozenset(
         "le la les de des du et en un une est pour que qui dans sur pas au "
-        "avec son ses par plus ne se ce cette mais ou donc".split()),
+        "avec son ses par plus ne se ce cette mais ou donc être avoir fait "
+        "comme tout nous vous leur aux".split()),
     LANG_SPANISH: frozenset(
         "el la los las de del y en un una es por que con para su como más "
-        "pero sus le ya o este sí porque esta entre cuando".split()),
+        "pero sus le ya o este sí porque esta entre cuando muy sin sobre "
+        "también hasta donde quien desde nos".split()),
     LANG_GERMAN: frozenset(
         "der die das und in den von zu mit sich des auf für ist im dem nicht "
-        "ein eine als auch es an werden aus er hat dass sie nach".split()),
+        "ein eine als auch es an werden aus er hat dass sie nach bei einer "
+        "um am sind noch wie über einen so zum war haben nur oder aber vor "
+        "zur bis mehr durch können".split()),
     LANG_ITALIAN: frozenset(
         "il la le di del e in un una è per che con non si da dei al come "
-        "più ma gli alla sono questo anche della nel".split()),
+        "più ma gli alla sono questo anche della nel quando essere molto "
+        "stato questa loro tutti".split()),
     LANG_PORTUGUESE: frozenset(
         "o a os as de do da e em um uma é por que com para seu como mais "
-        "mas foi ao não se na dos das pelo".split()),
+        "mas foi ao não se na dos das pelo uma os quando muito nos já está "
+        "também só pela até".split()),
     LANG_DUTCH: frozenset(
         "de het een en van in is dat op te zijn met voor niet aan er ook als "
-        "bij maar om uit door over ze hij".split()),
+        "bij maar om uit door over ze hij naar heeft worden wordt kunnen "
+        "geen deze zo nog wel".split()),
     LANG_RUSSIAN: frozenset(
         "и в не на я что он с как это по но они мы все она так его за был "
-        "от то же бы у вы из".split()),
+        "от то же бы у вы из ее мне еще нет о из-за когда даже ну если уже "
+        "или ни быть".split()),
+    LANG_SWEDISH: frozenset(
+        "och i att det som en på är av för med till den har de inte om ett "
+        "han men var jag sig från vi så kan man när år".split()),
+    LANG_POLISH: frozenset(
+        "i w nie na się że z do to jest jak po co tak ale o od za przez "
+        "przy już tylko był może przed być bardzo także czy ich".split()),
+    LANG_TURKISH: frozenset(
+        "bir ve bu da ne için ile olarak çok daha sonra kadar gibi ama en "
+        "diye olan her iki ya değil ise veya".split()),
 }
 
+#: Unicode script ranges → language (the charset-hint role of
+#: Language.cpp, charset-independent). Checked on the raw characters.
+_SCRIPTS: list[tuple[int, int, int]] = [
+    (0x3040, 0x30FF, LANG_JAPANESE),    # hiragana + katakana
+    (0xAC00, 0xD7AF, LANG_KOREAN),      # hangul syllables
+    (0x1100, 0x11FF, LANG_KOREAN),      # hangul jamo
+    (0x4E00, 0x9FFF, LANG_CHINESE),     # CJK unified (zh unless kana)
+    (0x0400, 0x04FF, LANG_RUSSIAN),     # cyrillic
+    (0x0590, 0x05FF, LANG_HEBREW),
+    (0x0600, 0x06FF, LANG_ARABIC),
+    (0x0370, 0x03FF, LANG_GREEK),
+    (0x0E00, 0x0E7F, LANG_THAI),
+    (0x0900, 0x097F, LANG_HINDI),       # devanagari
+]
 
-def detect_language(words: list[str], min_hits: int = 2) -> int:
-    """Best stopword-profile match over the token stream; LANG_UNKNOWN when
-    nothing clears the bar (the reference also falls back to charset and
-    TLD hints — callers can overlay those)."""
+
+def detect_script(text: str, sample: int = 4000) -> int:
+    """Dominant non-Latin script over a character sample → langId
+    (LANG_UNKNOWN when the text is overwhelmingly Latin/other)."""
+    counts: dict[int, int] = {}
+    total = 0
+    for ch in text[:sample]:
+        cp = ord(ch)
+        if cp < 0x0370:  # latin / punctuation / digits
+            continue
+        for lo, hi, lang in _SCRIPTS:
+            if lo <= cp <= hi:
+                counts[lang] = counts.get(lang, 0) + 1
+                total += 1
+                break
+    if not counts:
+        return LANG_UNKNOWN
+    best = max(counts, key=counts.get)
+    # Han characters are shared: kana presence means Japanese even when
+    # Han dominates the histogram
+    if best == LANG_CHINESE and counts.get(LANG_JAPANESE, 0) >= 2:
+        best = LANG_JAPANESE
+    # require the winning script to be a real presence, not stray chars
+    return best if counts[best] >= 5 else LANG_UNKNOWN
+
+
+def detect_language(words: list[str], min_hits: int = 2,
+                    text: str | None = None) -> int:
+    """Layered id: script first (decisive for non-Latin), then the best
+    normalized stopword-profile hit; LANG_UNKNOWN when nothing clears
+    the bar (the reference also overlays TLD hints — callers can)."""
+    if text is None and words:
+        text = " ".join(words[:400])
+    if text:
+        script = detect_script(text)
+        if script != LANG_UNKNOWN:
+            return script
     if not words:
         return LANG_UNKNOWN
     sample = set(words[:2000])
-    best, best_hits = LANG_UNKNOWN, 0
+    best, best_score, best_hits = LANG_UNKNOWN, 0.0, 0
     for lang, profile in _PROFILES.items():
-        # distinct stopwords hit, so one frequent word can't dominate
+        # distinct stopwords hit, normalized by profile size so big
+        # profiles don't win by surface area
         hits = len(sample & profile)
-        if hits > best_hits:
-            best, best_hits = lang, hits
+        score = hits / (len(profile) ** 0.5)
+        if score > best_score:
+            best, best_score, best_hits = lang, score, hits
     return best if best_hits >= min_hits else LANG_UNKNOWN

@@ -10,8 +10,11 @@ Here the same single-table idea: :data:`PARMS` declares every parameter
 once; :class:`Conf` (global scope, reference ``Conf.h:49`` / ``gb.conf``)
 and :class:`CollectionConf` (per-collection, reference ``coll.conf`` /
 ``CollectionRec``) are dict-backed objects generated from it, with JSON
-round-trip and an ``on_update`` hook the control plane uses to broadcast
-changes to every host (serve.parm_sync).
+round-trip and an ``on_update`` hook. The cluster broadcast (0x3f) is
+``parallel.cluster.ClusterClient.broadcast_parm`` /
+``attach_conf``: sequenced updates delivered to every node through the
+ordered retry-forever write queues and applied via ``/rpc/parm``
+(persisted per node, so they survive restarts).
 """
 
 from __future__ import annotations

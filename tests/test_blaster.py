@@ -1,0 +1,45 @@
+"""Blaster: query replay + two-endpoint diff (Blaster.h:31,
+main.cpp:1861,1898 blasterdiff)."""
+
+import json
+import sys
+
+from open_source_search_engine_tpu.build import docproc
+from open_source_search_engine_tpu.serve.server import SearchHTTPServer
+
+sys.path.insert(0, "tools")
+
+
+def _mk_server(tmp_path, name, docs):
+    srv = SearchHTTPServer(tmp_path / name, port=0)
+    coll = srv.colldb.get("main")
+    for url, html in docs:
+        docproc.index_document(coll, url, html)
+    srv.start()
+    return srv
+
+
+def test_replay_and_diff(tmp_path, capsys):
+    import blaster
+    docs = [(f"http://b.test/p{i}",
+             f"<html><body><p>blast words number{i}</p></body></html>")
+            for i in range(6)]
+    a = _mk_server(tmp_path, "a", docs)
+    b = _mk_server(tmp_path, "b", docs[:5])  # one doc missing on B
+    qf = tmp_path / "queries.txt"
+    qf.write_text("# comment\nblast words\nnumber3\nnumber5\n")
+    try:
+        ep_a = f"http://127.0.0.1:{a._httpd.server_port}"
+        ep_b = f"http://127.0.0.1:{b._httpd.server_port}"
+        rc = blaster.main([str(qf), ep_a, "--threads", "2"])
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rc == 0 and out["ok"] == 3 and out["errors"] == 0
+        assert out["qps"] > 0 and out["p50_ms"] is not None
+        # diff mode: B lacks number5 -> at least one query diffs
+        rc = blaster.main([str(qf), ep_a, "--diff", ep_b,
+                           "--threads", "2"])
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rc == 1 and out["diffs"] >= 1
+    finally:
+        a.stop()
+        b.stop()

@@ -174,3 +174,48 @@ def test_cluster_end_to_end(cluster):
     if late_shard == 0:
         res, urls = _search_urls(client, "latecomer", topk=5)
         assert "http://s.test/late" in urls
+
+
+@pytest.mark.slow
+def test_parm_broadcast_reaches_all_nodes_and_survives(cluster):
+    """The 0x3f parm broadcast: host0's client sequences a live parm
+    update to EVERY node (all shards, all twins), a dead node catches
+    up through the retry queue when it returns, and the value survives
+    a node restart (persisted coll.conf)."""
+    nodes, client = cluster
+    import urllib.request
+
+    def parm_on(s, r, name):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{nodes.ports[s][r]}/rpc/conf",
+            data=b"{}", method="POST")
+        with urllib.request.urlopen(req, timeout=5.0) as resp:
+            return json.load(resp)["conf"][name]
+
+    client.attach_conf_name = None  # doc marker only
+    client.broadcast_parm("spider_delay_ms", 4321)
+    for s in range(N_SHARDS):
+        for r in range(N_REPLICAS):
+            assert parm_on(s, r, "spider_delay_ms") == 4321, (s, r)
+
+    # dead node: update parks in its ordered queue, applies on return
+    nodes.kill(0, 1)
+    client.check_hosts()
+    client.broadcast_parm("spider_delay_ms", 9999)
+    assert parm_on(1, 0, "spider_delay_ms") == 9999
+    nodes.start(0, 1)
+    _wait_port(nodes.ports[0][1])
+    t0 = time.time()
+    while time.time() - t0 < 30:
+        client.check_hosts()
+        if client.pending_writes == 0 and \
+                parm_on(0, 1, "spider_delay_ms") == 9999:
+            break
+        time.sleep(0.5)
+    assert parm_on(0, 1, "spider_delay_ms") == 9999
+
+    # restart a node with no pending queue: the persisted conf serves
+    nodes.kill(1, 0)
+    nodes.start(1, 0)
+    _wait_port(nodes.ports[1][0])
+    assert parm_on(1, 0, "spider_delay_ms") == 9999

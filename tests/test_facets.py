@@ -1,0 +1,149 @@
+"""Facets, numeric range operators, and date search (the datedb role).
+
+Reference: ``gbmin:``/``gbmax:``/``gbsortby:``/``gbfacet:`` fielded
+terms (``Query.h:209``), structured-document ingestion (``qa.cpp:2910``
+qajson), and ``Datedb.h:60`` (date-constrained search)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from open_source_search_engine_tpu.build import docproc
+from open_source_search_engine_tpu.index.collection import Collection
+from open_source_search_engine_tpu.query import engine
+from open_source_search_engine_tpu.query.compiler import compile_query
+from open_source_search_engine_tpu.query.engine import (search,
+                                                        search_device)
+
+
+def _doc(i):
+    return json.dumps({
+        "title": f"Product {i} widget",
+        "body": "common widget words here",
+        "price": 10.0 * (i + 1),
+        "rating": i % 5,
+        "category": "tools" if i % 3 == 0 else "toys",
+        "date": f"2024-0{(i % 8) + 1}-15",
+    })
+
+
+@pytest.fixture(scope="module")
+def coll(tmp_path_factory):
+    c = Collection("facets", tmp_path_factory.mktemp("facets"))
+    c.conf.pqr_enabled = False
+    for i in range(24):
+        docproc.index_document(c, f"http://shop.test/p{i}", _doc(i))
+    return c
+
+
+def test_json_fields_extracted_and_stored(coll):
+    rec = docproc.get_document(coll, url="http://shop.test/p3")
+    assert rec["fields"]["price"] == 40.0
+    assert rec["fields"]["category"] == "tools"
+    assert rec["fields"]["date"] > 1.7e9  # parsed to epoch seconds
+    # numeric fields land in fielddb
+    docids, vals = coll.fielddb.column("price")
+    assert len(docids) == 24 and 40.0 in vals
+
+
+def test_gbmin_gbmax_filters(coll):
+    plan = compile_query("widget gbmin:price:55 gbmax:price:145")
+    assert plan.filters == {"price": [55.0, 145.0]}
+    res = search(coll, "widget gbmin:price:55 gbmax:price:145",
+                 topk=24, site_cluster=False, with_snippets=False)
+    # prices 60..140 → 9 docs
+    assert res.total_matches == 9
+    for r in res.results:
+        rec = docproc.get_document(coll, docid=r.docid)
+        assert 55.0 <= rec["fields"]["price"] <= 145.0
+
+
+def test_filter_parity_flat_vs_device(coll):
+    q = "widget gbmin:price:55 gbmax:price:145"
+    host = search(coll, q, topk=24, site_cluster=False,
+                  with_snippets=False)
+    dev = search_device(coll, q, topk=24, site_cluster=False,
+                        with_snippets=False)
+    assert dev.total_matches == host.total_matches
+    assert {r.docid for r in dev.results} == \
+        {r.docid for r in host.results}
+    assert [round(r.score, 3) for r in dev.results] == \
+        [round(r.score, 3) for r in host.results]
+
+
+def test_gbsortby_numeric(coll):
+    res = search(coll, "widget gbsortby:price", topk=5,
+                 site_cluster=False, with_snippets=False)
+    prices = [docproc.get_document(coll, docid=r.docid)["fields"]["price"]
+              for r in res.results]
+    assert prices == sorted(prices, reverse=True)  # descending
+    res2 = search(coll, "widget gbsortbyrev:price", topk=5,
+                  site_cluster=False, with_snippets=False)
+    prices2 = [docproc.get_document(coll, docid=r.docid)["fields"]["price"]
+               for r in res2.results]
+    assert prices2 == sorted(prices2)  # ascending
+
+
+def test_gbsortby_date_parity(coll):
+    q = "widget gbsortby:date"
+    host = search(coll, q, topk=8, site_cluster=False,
+                  with_snippets=False)
+    dev = search_device(coll, q, topk=8, site_cluster=False,
+                        with_snippets=False)
+    dates = [docproc.get_document(coll, docid=r.docid)["fields"]["date"]
+             for r in host.results]
+    assert dates == sorted(dates, reverse=True)  # newest first
+    assert [round(r.score, 3) for r in dev.results] == \
+        [round(r.score, 3) for r in host.results]
+
+
+def test_gbfacet_counts(coll):
+    res = search(coll, "widget gbfacet:category", topk=10,
+                 site_cluster=False, with_snippets=False)
+    facets = dict(res.facets["category"])
+    assert facets["tools"] == 8 and facets["toys"] == 16
+    dev = search_device(coll, "widget gbfacet:category", topk=10,
+                        site_cluster=False, with_snippets=False)
+    dfac = dict(dev.facets["category"])
+    assert dfac["tools"] >= 1 and dfac["toys"] >= 1  # sampled
+
+
+def test_delete_removes_field_records(tmp_path):
+    c = Collection("fdel", tmp_path)
+    c.conf.pqr_enabled = False
+    docproc.index_document(c, "http://shop.test/x", _doc(1))
+    assert len(c.fielddb.column("price")[0]) == 1
+    docproc.remove_document(c, "http://shop.test/x")
+    assert len(c.fielddb.column("price")[0]) == 0
+
+
+def test_date_range_filter(coll):
+    # docs dated 2024-03-15 .. 2024-05-15 only
+    import calendar
+    lo = calendar.timegm((2024, 3, 1, 0, 0, 0))
+    hi = calendar.timegm((2024, 5, 30, 0, 0, 0))
+    res = search(coll, f"widget gbmin:date:{lo} gbmax:date:{hi}",
+                 topk=24, site_cluster=False, with_snippets=False)
+    assert res.total_matches == 9  # months 3,4,5 → 3 each
+    for r in res.results:
+        d = docproc.get_document(coll, docid=r.docid)["fields"]["date"]
+        assert lo <= d <= hi
+
+
+def test_sharded_filter_parity(tmp_path):
+    from open_source_search_engine_tpu.parallel import (make_mesh,
+                                                        sharded_search)
+    from open_source_search_engine_tpu.parallel.sharded import \
+        ShardedCollection
+    sc = ShardedCollection("fshard", tmp_path, n_shards=4)
+    for row in sc.grid:
+        for c in row:
+            c.conf.pqr_enabled = False
+    for i in range(24):
+        sc.index_document(f"http://shop.test/p{i}", _doc(i))
+    mesh = make_mesh(4)
+    res = sharded_search(sc, "widget gbmin:price:55 gbmax:price:145",
+                         mesh=mesh, topk=24, site_cluster=False,
+                         with_snippets=False)
+    assert res.total_matches == 9

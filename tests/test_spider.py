@@ -94,9 +94,37 @@ class TestScheduler:
         s.add_url("http://slow.test/1")
         s.add_url("http://slow.test/2")
         now = time.monotonic()
-        assert len(s.next_batch(2, now=now)) == 1     # host throttled
+        b = s.next_batch(2, now=now)
+        assert len(b) == 1                    # same IP: one in flight
         assert len(s.next_batch(2, now=now)) == 0
-        assert len(s.next_batch(2, now=now + 61)) == 1
+        # in-flight: even far in the future the IP stays locked until
+        # the fetch completes (the doledb-lock role)
+        assert len(s.next_batch(2, now=now + 61)) == 0
+        s.release(b[0].url, now=now)          # fetch done -> window runs
+        assert len(s.next_batch(2, now=now + 1)) == 0   # still waiting
+        assert len(s.next_batch(2, now=now + 61)) == 1  # window passed
+
+    def test_per_ip_discipline_across_hosts(self):
+        """Two HOSTS resolving to one IP share a politeness window and
+        are never in flight together (Spider.h firstIP semantics)."""
+        ips = {"a.shared.test": "10.0.0.7", "b.shared.test": "10.0.0.7",
+               "other.test": "10.0.0.9"}
+        s = SpiderScheduler(filters=[UrlFilterRule("*", delay_s=30.0)],
+                            resolver=lambda h: ips.get(h, "10.9.9.9"))
+        s.add_url("http://a.shared.test/x")
+        s.add_url("http://b.shared.test/y")
+        s.add_url("http://other.test/z")
+        now = time.monotonic()
+        b = s.next_batch(3, now=now)
+        # one url per IP per batch: the shared IP contributes ONE url
+        assert len(b) == 2
+        assert {r.first_ip for r in b} == {"10.0.0.7", "10.0.0.9"}
+        assert len(s.next_batch(3, now=now + 999)) == 0  # in flight
+        for r in b:
+            s.release(r.url, now=now)
+        # shared IP's second host only after the window
+        assert len(s.next_batch(3, now=now + 1)) == 0
+        assert len(s.next_batch(3, now=now + 31)) == 1
 
 
 class TestSiteRank:

@@ -20,7 +20,10 @@ class TestDurableFrontier:
             assert s.add_url(u)
         batch = []
         for i in range(8):  # one per politeness window (same host)
-            batch += s.next_batch(1, now=1000.0 * (i + 1))
+            got = s.next_batch(1, now=1000.0 * (i + 1))
+            batch += got
+            for r in got:  # fetch completes -> IP lock releases
+                s.release(r.url, now=1000.0 * (i + 1))
         assert len(batch) == 8
         for r in batch:
             s.mark_done(r.url)
@@ -34,7 +37,10 @@ class TestDurableFrontier:
         t = 1e12
         while not s2.exhausted:
             t += 1000.0
-            doled += [r.url for r in s2.next_batch(50, now=t)]
+            got = s2.next_batch(50, now=t)
+            doled += [r.url for r in got]
+            for r in got:  # fetch completes -> IP lock releases
+                s2.release(r.url, now=t)
         assert set(doled) == set(urls(20)) - done  # no re-fetches
         # completed + pending urls stay deduped after restart
         for u in urls(20):
@@ -100,7 +106,7 @@ class TestDurableFrontier:
         # politeness: same host, so drain with many steps
         for _ in range(30):
             loop.crawl_step()
-            sched.host_ready_at.clear()           # fast-forward politeness
+            sched.ip_ready_at.clear()             # fast-forward politeness
             if sched.exhausted:
                 break
         assert loop.stats.indexed == 6
@@ -108,3 +114,36 @@ class TestDurableFrontier:
         s2 = DurableSpiderScheduler(tmp_path / "sp", max_hops=10)
         assert len(s2) == 0
         assert not s2.add_url("http://crawl.test/p3")
+
+
+def test_same_ip_hosts_share_shard_and_never_fetch_concurrently(tmp_path):
+    """Cluster-wide per-IP discipline (Spider.h:99-108 firstIP keying):
+    every host resolving to one IP routes to ONE shard, and that
+    shard's scheduler never doles two urls of the IP concurrently — so
+    no multi-node crawl can hammer an IP from N nodes."""
+    from open_source_search_engine_tpu.spider.spiderdb import \
+        shard_of_url
+    ips = {"a.cdn.test": "93.1.2.3", "b.cdn.test": "93.1.2.3",
+           "c.cdn.test": "93.1.2.3", "solo.test": "94.4.5.6"}
+    res = lambda h: ips.get(h, "0.0.0.1")
+    # same IP → same shard, for any shard count
+    for n in (2, 4, 16):
+        shards = {shard_of_url(f"http://{h}.test/x", n, resolver=res)
+                  for h in ("a.cdn", "b.cdn", "c.cdn")}
+        shards2 = {shard_of_url(f"http://{h}/p{i}", n, resolver=res)
+                   for h in ("a.cdn.test", "b.cdn.test", "c.cdn.test")
+                   for i in range(5)}
+        assert len(shards2) == 1
+    # the owning shard's scheduler serializes the IP (in-flight lock)
+    s = DurableSpiderScheduler(tmp_path, resolver=res)
+    for h in ("a.cdn.test", "b.cdn.test", "c.cdn.test", "solo.test"):
+        assert s.add_url(f"http://{h}/page")
+    got = s.next_batch(10, now=1e9)
+    by_ip = {}
+    for r in got:
+        by_ip[r.first_ip] = by_ip.get(r.first_ip, 0) + 1
+    assert by_ip == {"93.1.2.3": 1, "94.4.5.6": 1}
+    assert s.next_batch(10, now=2e9) == []  # both IPs in flight
+    for r in got:
+        s.release(r.url, now=2e9)
+    assert len(s.next_batch(10, now=3e9)) == 1  # next cdn url, one IP

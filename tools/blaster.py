@@ -1,0 +1,145 @@
+"""Blaster — query replay / load test / cluster diff against live
+``/search`` endpoints.
+
+Reference: ``gb blaster`` replays a query (or url) file in parallel
+against a cluster (``Blaster.h:31``, ``main.cpp:1861``) and
+``blasterdiff`` (``main.cpp:1898``) fires each query at TWO clusters
+and reports result differences side by side — the tool the reference
+uses when "Changing Live Clusters" (developer.html §F.5). This is the
+validation instrument for perf claims outside the synthetic bench.
+
+Usage::
+
+    python tools/blaster.py QUERYFILE http://host:8000 \
+        [--qps 10] [--n 10] [--threads 8] [--max 1000] [--format json]
+    python tools/blaster.py QUERYFILE http://a:8000 --diff http://b:8000
+
+QUERYFILE: one query per line (# comments skipped). Prints a JSON
+summary line (qps achieved, latency percentiles, error count; in diff
+mode also per-query result mismatches).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+import urllib.parse
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+
+def _search(base: str, q: str, n: int, timeout: float) -> dict:
+    url = (f"{base}/search?format=json&n={n}&q="
+           + urllib.parse.quote_plus(q))
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.load(r)
+
+
+def _load_queries(path: str, limit: int | None) -> list[str]:
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                out.append(line)
+            if limit and len(out) >= limit:
+                break
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("queryfile")
+    ap.add_argument("endpoint", help="http://host:port of /search")
+    ap.add_argument("--diff", metavar="ENDPOINT2",
+                    help="second endpoint: compare results per query "
+                         "(blasterdiff)")
+    ap.add_argument("--qps", type=float, default=0.0,
+                    help="target request rate (0 = as fast as the "
+                         "thread pool allows)")
+    ap.add_argument("--n", type=int, default=10, help="results per query")
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--max", type=int, default=0,
+                    help="replay at most this many queries (0 = all)")
+    ap.add_argument("--timeout", type=float, default=30.0)
+    args = ap.parse_args(argv)
+
+    queries = _load_queries(args.queryfile, args.max or None)
+    if not queries:
+        print("no queries", file=sys.stderr)
+        return 2
+
+    lats: list[float] = []
+    errors = [0]
+    diffs: list[dict] = []
+    lock = threading.Lock()
+
+    def one(q: str) -> None:
+        t0 = time.perf_counter()
+        try:
+            a = _search(args.endpoint, q, args.n, args.timeout)
+        except Exception as e:  # noqa: BLE001 — network errors are data
+            with lock:
+                errors[0] += 1
+            print(f"# ERROR {q!r}: {e}", file=sys.stderr)
+            return
+        dt = 1000 * (time.perf_counter() - t0)
+        with lock:
+            lats.append(dt)
+        if args.diff:
+            try:
+                b = _search(args.diff, q, args.n, args.timeout)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors[0] += 1
+                print(f"# ERROR(B) {q!r}: {e}", file=sys.stderr)
+                return
+            ua = [r["url"] for r in a.get("results", [])]
+            ub = [r["url"] for r in b.get("results", [])]
+            if ua != ub or a.get("totalMatches") != b.get("totalMatches"):
+                with lock:
+                    diffs.append({
+                        "q": q,
+                        "totalA": a.get("totalMatches"),
+                        "totalB": b.get("totalMatches"),
+                        "onlyA": [u for u in ua if u not in ub][:5],
+                        "onlyB": [u for u in ub if u not in ua][:5],
+                    })
+
+    t0 = time.perf_counter()
+    interval = 1.0 / args.qps if args.qps > 0 else 0.0
+    with ThreadPoolExecutor(args.threads) as pool:
+        for i, q in enumerate(queries):
+            if interval:
+                # rate pacing: schedule each request at its slot
+                target = t0 + i * interval
+                delay = target - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+            pool.submit(one, q)
+    elapsed = time.perf_counter() - t0
+
+    lats.sort()
+    pct = lambda p: round(lats[int(p * (len(lats) - 1))], 1) \
+        if lats else None
+    out = {
+        "queries": len(queries),
+        "ok": len(lats),
+        "errors": errors[0],
+        "elapsed_s": round(elapsed, 2),
+        "qps": round(len(lats) / elapsed, 2) if elapsed else 0,
+        "p50_ms": pct(0.50), "p90_ms": pct(0.90), "p99_ms": pct(0.99),
+    }
+    if args.diff:
+        out["diffs"] = len(diffs)
+        for d in diffs[:20]:
+            print("# DIFF " + json.dumps(d), file=sys.stderr)
+    print(json.dumps(out))
+    return 0 if not errors[0] and not (args.diff and diffs) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
