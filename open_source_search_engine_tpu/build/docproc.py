@@ -142,8 +142,7 @@ def _spam_ranks(words: list[str]) -> np.ndarray:
     return np.where(frac > 0.125, docked, ranks)
 
 
-def extract_fields(content: str, tdoc=None,
-                   is_html: bool = True) -> dict:
+def extract_fields(content: str) -> dict:
     """Structured document fields (the qajson/qaxml ingestion path,
     ``qa.cpp:2910``): a JSON document's scalars become fields (nested
     objects flatten with dots). Strings feed facets; numbers feed
@@ -244,7 +243,7 @@ def build_meta_list(
     site = site or u.site
     docid = ghash.doc_id(u.full)
     if fields is None:
-        fields = extract_fields(content, is_html=is_html)
+        fields = extract_fields(content)
     if tdoc is None:
         tdoc = _tokenize_doc(content, u.full, is_html, fields)
     edges = resolve_links(tdoc.links, u.full)
@@ -522,7 +521,7 @@ def index_document(coll: Collection, url: str, content: str, *,
     inlinks = coll.linkdb.inlinks_for_url(site, u.full)
     # boilerplate gate (Sections dup votes): sections this page shares
     # with enough sibling pages of the site demote at build time
-    flds = extract_fields(content, is_html=is_html)
+    flds = extract_fields(content)
     tdoc = _tokenize_doc(content, u.full, is_html, flds)
     sect_of = doc_section_hashes(tdoc)
     boiler = coll.sectiondb.boiler_set(site, sect_of.values())
@@ -619,7 +618,7 @@ def index_batch(coll: Collection, docs, *, is_html: bool = True,
     reads = []
     for i, u, url, content, site, sr in work:
         inlinks = coll.linkdb.inlinks_for_url(site, u.full)
-        flds = extract_fields(content, is_html=is_html)
+        flds = extract_fields(content)
         tdoc = _tokenize_doc(content, u.full, is_html, flds)
         sect_of = doc_section_hashes(tdoc)
         boiler = coll.sectiondb.boiler_set(site, sect_of.values())

@@ -507,8 +507,12 @@ class ClusterClient:
         #: signal (least-loaded twin serves reads)
         self._read_ewma = [[0.0] * conf.n_replicas
                            for _ in range(conf.n_shards)]
-        #: 0x3f broadcast sequencer (this client == the host0 role)
-        self._parm_counter = 0
+        #: 0x3f broadcast sequencer (this client == the host0 role).
+        #: Seeded from the wall clock so a RESTARTED host0 client's
+        #: sequence numbers stay above everything the nodes have seen
+        #: (an in-memory counter restarting at 0 would make every
+        #: post-restart broadcast look stale and be silently dropped)
+        self._parm_counter = int(time.time() * 1000)
         self._stop = threading.Event()
         self._pool = ThreadPoolExecutor(
             max_workers=max(4, 2 * conf.n_shards * conf.n_replicas))

@@ -504,9 +504,9 @@ def sharded_search(sc: ShardedCollection, q: str | QueryPlan, *,
     sort_base = None
     if plan.sortby is not None:
         from ..query.packer import local_sort_base
-        bases = [local_sort_base(c, *plan.sortby)
-                 for i, c in enumerate(sc.shards)
-                 if serving[i] is not None]
+        bases = [b for i, c in enumerate(sc.shards)
+                 if serving[i] is not None
+                 and (b := local_sort_base(c, *plan.sortby)) is not None]
         sort_base = min(bases) if bases else 0.0
     preps = [prepare_query(c, plan, sort_base=sort_base)
              if serving[i] is not None else None
@@ -661,7 +661,9 @@ class MeshResident:
         return sum(di._df_of(termid) for di in self.indexes)
 
     def _global_sort_base(self, fld: str, desc: bool) -> float:
-        return min(di.sort_base_of(fld, desc) for di in self.indexes)
+        bases = [b for di in self.indexes
+                 if (b := di.sort_base_of(fld, desc)) is not None]
+        return min(bases) if bases else 0.0
 
     def search_batch(self, queries, topk: int = 10, lang: int = 0,
                      offset: int = 0, with_snippets: bool = True,

@@ -935,13 +935,14 @@ class DeviceIndex:
         cache[(fld, ver)] = col
         return col
 
-    def sort_base_of(self, fld: str, desc: bool) -> float:
+    def sort_base_of(self, fld: str, desc: bool) -> float | None:
         """This shard's minimum finite sort key for a field (keys are
-        v for descending, -v for ascending)."""
+        v for descending, -v for ascending); None when the shard has
+        no finite values (must not poison the cross-shard min)."""
         col = self._field_col(fld)
         key = col if desc else -col
         fin = np.isfinite(key)
-        return float(key[fin].min()) if fin.any() else 0.0
+        return float(key[fin].min()) if fin.any() else None
 
     def _filter_sort_cols(self, p: "ResidentPlan"):
         """(d_filter, d_sort, use_filter, use_sort) for one wave —
@@ -1188,7 +1189,8 @@ class DeviceIndex:
                 (f, tuple(v)) for f, v in qplan.filters.items())),
             sortby=qplan.sortby,
             sort_base=(
-                (sort_base_of or self.sort_base_of)(*qplan.sortby)
+                ((sort_base_of or self.sort_base_of)(*qplan.sortby)
+                 or 0.0)
                 if qplan.sortby is not None else 0.0))
 
     # --- execution -------------------------------------------------------
@@ -1416,7 +1418,9 @@ class DeviceIndex:
         kap = min(KAPPA_FLOOR, self.D_cap)
         shape_grid = ((1, 1), (2, 1), (1, 2), (3, 3), (5, 5), (17, 1))
         b1 = self._f1_bmax()
-        nbs = tuple(sorted({1, min(5, b1), min(9, b1), min(33, b1)}))
+        # one nb per runtime B bucket (4/8/16/32/64), capped by the
+        # HBM budget so warm never compiles a shape runtime can't use
+        nbs = tuple(sorted({min(nb, b1) for nb in (1, 5, 9, 17, 33)}))
         for ns, nd in shape_grid:          # κ=256 base rung
             for nb in nbs:                 # B buckets the budget allows
                 # single-group (k2=128) AND multi-group (k2=κ) widths

@@ -28,6 +28,11 @@ from .scorer import run_query
 
 log = get_logger("query")
 
+#: guards first-time creation of a collection's device-index lock
+import threading as _threading  # noqa: E402
+
+_DI_CREATE_LOCK = _threading.Lock()
+
 
 #: site-clustering cap: at most this many results per site
 #: (reference Msg51/Msg40 "site clustering (max 2/site)", Msg51.h:96)
@@ -279,14 +284,70 @@ def _suggest(coll: Collection, plan: QueryPlan) -> str | None:
 
 def get_device_index(coll: Collection):
     """The collection's HBM-resident index, built lazily and refreshed
-    when the Rdb version moves (cached on the Collection object)."""
+    when the Rdb version moves (cached on the Collection object).
+
+    A run-set move (dump/merge) triggers an O(corpus) base rebuild —
+    the reference's RdbDump/RdbMerge never block the loop
+    (``RdbDump.h:21``), and neither does this: the rebuild runs in a
+    BACKGROUND thread against a fresh DeviceIndex while the old one
+    keeps serving its pre-dump view (frozen — bounded staleness for
+    the rebuild's duration), then swaps in atomically. Memtable-only
+    changes refresh synchronously (O(memtable)). When the HBM can't
+    hold two resident sets (big shards), the swap degrades to a
+    blocking rebuild rather than an OOM."""
+    import threading
+
     from .devindex import DeviceIndex
+    lock = getattr(coll, "_di_lock", None)
+    if lock is None:
+        with _DI_CREATE_LOCK:
+            lock = getattr(coll, "_di_lock", None)
+            if lock is None:
+                lock = coll._di_lock = threading.Lock()
     di = getattr(coll, "_device_index", None)
     if di is None:
-        di = DeviceIndex(coll)
-        coll._device_index = di
-    else:
-        di.refresh()
+        with lock:
+            di = getattr(coll, "_device_index", None)
+            if di is None:
+                di = DeviceIndex(coll)
+                coll._device_index = di
+        return di
+
+    rdb = coll.posdb
+    if rdb.version == di._built_version:
+        return di
+    fp = tuple((r.path.name, len(r), r.meta.get("keys_crc"))
+               for r in rdb.runs)
+    if fp == di._base_fp:
+        di.refresh()  # delta-only: O(memtable), synchronous
+        return di
+    # run set moved → full rebuild. Double-residency check: old + new
+    # device arrays must both fit while the swap is in flight.
+    res_bytes = sum(
+        int(np.prod(a.shape)) * a.dtype.itemsize
+        for a in (di.d_payload, di.d_doc, di.d_imp, di.d_rsp,
+                  di.d_dense_imp, di.d_dense_rsp, di.d_cube))
+    if 2 * res_bytes + (2 << 30) > (14 << 30):
+        di.refresh()  # blocking rebuild — two sets would OOM
+        return di
+    with lock:
+        if getattr(coll, "_di_rebuilding", False):
+            return di  # a rebuild is in flight: serve the old view
+
+        def _rebuild():
+            try:
+                fresh = DeviceIndex(coll)
+                with lock:
+                    coll._device_index = fresh
+            except Exception:  # noqa: BLE001 — keep serving the old
+                log.exception("background device rebuild failed")
+            finally:
+                with lock:
+                    coll._di_rebuilding = False
+
+        coll._di_rebuilding = True
+        threading.Thread(target=_rebuild, daemon=True,
+                         name="devindex-rebuild").start()
     return di
 
 

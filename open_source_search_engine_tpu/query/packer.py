@@ -267,14 +267,17 @@ def _field_values(coll: Collection, fld: str,
     return out
 
 
-def local_sort_base(coll: Collection, fld: str, desc: bool) -> float:
+def local_sort_base(coll: Collection, fld: str,
+                    desc: bool) -> float | None:
     """This collection's minimum finite sort key (v desc, -v asc) —
-    the shift that keeps device sort keys positive. The SHARDED paths
-    take the min across shards so merged keys stay comparable."""
+    the shift that keeps device sort keys positive AND small (float32
+    resolution collapses at e.g. epoch-seconds magnitude). None when
+    the shard has no finite values: an empty shard must not poison the
+    cross-shard min with a 0.0 sentinel."""
     _, allvals = coll.fielddb.column(fld)
     av = allvals if desc else -allvals
     fin = np.isfinite(av)
-    return float(av[fin].min()) if fin.any() else 0.0
+    return float(av[fin].min()) if fin.any() else None
 
 
 def field_arrays(coll: Collection, plan: QueryPlan, cand: np.ndarray,
@@ -296,6 +299,8 @@ def field_arrays(coll: Collection, plan: QueryPlan, cand: np.ndarray,
         key = dv if desc else -dv
         base = sort_base if sort_base is not None \
             else local_sort_base(coll, fld, desc)
+        if base is None:
+            base = 0.0  # no finite values anywhere: keys are all 0.25
         finite = np.isfinite(key)
         sortc = np.where(finite, key - base + 1.0,
                          0.25).astype(np.float32)

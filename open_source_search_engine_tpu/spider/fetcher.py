@@ -126,25 +126,30 @@ class ResponseCache:
     from cache instead of re-hammering the site). Bounded LRU-ish."""
 
     def __init__(self, ttl_s: float = 3600.0, max_entries: int = 1024):
+        import threading
         self.ttl_s = ttl_s
         self.max_entries = max_entries
         self._d: dict[str, tuple[float, FetchResult]] = {}
+        self._lock = threading.Lock()  # shared across fetch threads
 
     def get(self, url: str) -> FetchResult | None:
         import time
-        hit = self._d.get(url)
+        with self._lock:
+            hit = self._d.get(url)
         if hit is None or hit[0] < time.monotonic():
             return None
         return hit[1]
 
     def put(self, url: str, res: FetchResult) -> None:
         import time
-        if len(self._d) >= self.max_entries:
-            # drop the stalest half (cheap, rare)
-            for k in sorted(self._d, key=lambda k: self._d[k][0])[
-                    : self.max_entries // 2]:
-                del self._d[k]
-        self._d[url] = (time.monotonic() + self.ttl_s, res)
+        with self._lock:
+            if len(self._d) >= self.max_entries:
+                # drop the stalest half (cheap, rare)
+                for k in sorted(self._d,
+                                key=lambda k: self._d[k][0])[
+                        : self.max_entries // 2]:
+                    del self._d[k]
+            self._d[url] = (time.monotonic() + self.ttl_s, res)
 
 
 class Fetcher:

@@ -56,7 +56,13 @@ def first_ip(host: str, timeout: float = 5.0) -> str:
         else:
             owner = False
     if not owner:
-        ev.wait(timeout)
+        # wait past the owner's own lookup bound: the owner ALWAYS
+        # caches something (real IP or pseudo) and sets the event, so
+        # the waiter nearly always reads the same value the owner
+        # cached — a split (waiter pseudo vs owner real) only happens
+        # if this wait itself expires, and downstream consumers carry
+        # the doled first_ip rather than re-resolving
+        ev.wait(timeout + 1.0)
         with _lock:
             hit = _cache.get(host)
         return hit[0] if hit is not None else _pseudo_ip(host)
@@ -83,12 +89,13 @@ def first_ip(host: str, timeout: float = 5.0) -> str:
             ip = box[0] if box else _pseudo_ip(host)
     except Exception:  # noqa: BLE001 — unresolvable host
         ip = _pseudo_ip(host)
-    with _lock:
-        if len(_cache) > 65536:
-            _cache.clear()
-        _cache[host] = (ip, now + TTL_S)
-        _inflight.pop(host, None)
-    ev.set()
+    finally:
+        with _lock:
+            if len(_cache) > 65536:
+                _cache.clear()
+            _cache[host] = (ip, now + TTL_S)
+            _inflight.pop(host, None)
+        ev.set()
     return ip
 
 
