@@ -58,6 +58,13 @@ def cmd_serve(args) -> int:
     proc.register(srv.colldb)
     proc.install_signal_handlers()
     proc.start_autosave()
+    # quiet-hours full merges (DailyMerge.h:11); window from the
+    # merge_quiet_hours parm, empty = disabled
+    from .control.dailymerge import DailyMerge
+    dm = DailyMerge(lambda: [srv.colldb.get(n)
+                             for n in srv.colldb.names()], srv.conf)
+    dm.start()
+    proc.on_shutdown(dm.stop)
     srv.start()
     print(f"node serving on http://{args.host}:{srv.port} "
           f"(coll={args.coll}, dir={args.dir}) — Ctrl-C to save+stop",
@@ -74,6 +81,33 @@ def cmd_serve(args) -> int:
         pass
     proc.shutdown()
     srv.stop()
+    return 0
+
+
+def cmd_proxy(args) -> int:
+    """Query-routing front proxy (the ``gb proxy`` mode,
+    ``main.cpp:1691`` / ``Proxy.cpp``): a stateless front end that fans
+    /search out to the cluster's nodes and serves merged results — no
+    local index, no spider; run several behind a load balancer."""
+    import tempfile
+
+    from .parallel.cluster import ClusterClient, HostsConf
+    from .serve.server import SearchHTTPServer
+
+    cluster = ClusterClient(HostsConf.load(args.hosts))
+    srv = SearchHTTPServer(tempfile.mkdtemp(prefix="osse_proxy_"),
+                           host=args.host, port=args.port,
+                           cluster=cluster)
+    srv.start()
+    print(f"proxy on http://{args.host}:{srv.port} "
+          f"-> cluster {args.hosts} — Ctrl-C to stop", flush=True)
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    srv.stop()
+    cluster.close()
     return 0
 
 
@@ -259,6 +293,16 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--device", action="store_true",
                    help="serve queries from the HBM-resident index")
     p.set_defaults(fn=cmd_node)
+
+    p = sub.add_parser("proxy", help="query-routing front proxy "
+                                     "(gb proxy mode): /search fans "
+                                     "out to the cluster, no local "
+                                     "index")
+    p.add_argument("--hosts", required=True,
+                   help="hosts.conf cluster topology")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.set_defaults(fn=cmd_proxy)
 
     p = sub.add_parser("save", help="checkpoint all collections")
     _add_dir(p)

@@ -862,6 +862,17 @@ class DeviceIndex:
     def n_docs(self) -> int:
         return len(self.all_docids)
 
+    def resident_bytes(self) -> int:
+        """Total device bytes this index holds resident — the number
+        the background-rebuild double-residency gate reasons about."""
+        import numpy as _np
+        return sum(
+            int(_np.prod(a.shape)) * a.dtype.itemsize
+            for a in (self.d_payload, self.d_pdoc, self.d_pocc,
+                      self.d_doc, self.d_imp, self.d_rsp,
+                      self.d_dense_imp, self.d_dense_rsp, self.d_cube,
+                      self.d_siterank, self.d_doclang, self.d_dead))
+
     def _docid_pos(self, docids_arr: np.ndarray) -> tuple[np.ndarray,
                                                           np.ndarray]:
         """(row positions, found mask) of docids in all_docids.
@@ -1473,6 +1484,12 @@ class DeviceIndex:
         pl.g_quarter = np.zeros((T, 4), np.int32)
         pl.g_qsyn = np.zeros((T, 4), np.uint32)
         pl.p_len[0] = 513  # Lp=4096 bucket
+        pl2 = dummy()
+        pl2.g_quarter = np.zeros((T, 4), np.int32)
+        pl2.g_qsyn = np.zeros((T, 4), np.uint32)
+        pl2.p_len[0] = F2_LPOST_FLOOR + 1  # Lp=16384 bucket (big
+        # bigram scatter tails — one unwarmed hit cost a 91 s compile
+        # inside a measured pass)
         for n_sel in (2048, 8192):
             for nb in nb_big:
                 outs.append(self._run_batch_fd(
@@ -1482,6 +1499,8 @@ class DeviceIndex:
                         [pt] * nb, k2, min(n_sel, self.D_cap)))
                     outs.append(self._run_batch_fd(
                         [pl] * nb, k2, min(n_sel, self.D_cap)))
+                    outs.append(self._run_batch_fd(
+                        [pl2] * nb, k2, min(n_sel, self.D_cap)))
         jax.device_get(outs)
         return len(outs)
 

@@ -319,16 +319,14 @@ def get_device_index(coll: Collection):
     fp = tuple((r.path.name, len(r), r.meta.get("keys_crc"))
                for r in rdb.runs)
     if fp == di._base_fp:
-        di.refresh()  # delta-only: O(memtable), synchronous
+        with lock:  # concurrent /search threads must not both mutate
+            di.refresh()  # delta-only: O(memtable), synchronous
         return di
     # run set moved → full rebuild. Double-residency check: old + new
     # device arrays must both fit while the swap is in flight.
-    res_bytes = sum(
-        int(np.prod(a.shape)) * a.dtype.itemsize
-        for a in (di.d_payload, di.d_doc, di.d_imp, di.d_rsp,
-                  di.d_dense_imp, di.d_dense_rsp, di.d_cube))
-    if 2 * res_bytes + (2 << 30) > (14 << 30):
-        di.refresh()  # blocking rebuild — two sets would OOM
+    if 2 * di.resident_bytes() + (2 << 30) > (14 << 30):
+        with lock:
+            di.refresh()  # blocking rebuild — two sets would OOM
         return di
     with lock:
         if getattr(coll, "_di_rebuilding", False):

@@ -1,0 +1,153 @@
+"""Crawlbot — the REST bulk-crawl API.
+
+Reference: ``PageCrawlBot.cpp`` (~5k LoC, the diffbot-era "crawlbot"
+API): REST calls create named crawl jobs (each backed by its own
+collection + url filters), report status, pause/resume, and expose the
+crawled corpus. Endpoints here (admin-gated like injection):
+
+* ``/crawlbot?name=X&seeds=url1,url2&maxpages=N&maxhops=H`` — create
+  and start a job: a dedicated collection ``crawl_X`` with a durable
+  per-IP frontier, crawled by a background loop.
+* ``/crawlbot?name=X`` — job status (indexed/fetched/errors/frontier).
+* ``/crawlbot?name=X&action=pause|resume|delete``.
+* ``/crawlbot`` — list jobs.
+
+Searches over a job's corpus use the normal ``/search?c=crawl_X``.
+"""
+
+from __future__ import annotations
+
+import shutil
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..spider.fetcher import Fetcher
+from ..spider.loop import SpiderLoop
+from ..spider.scheduler import UrlFilterRule
+from ..spider.spiderdb import DurableSpiderScheduler
+from ..utils.log import get_logger
+
+log = get_logger("crawlbot")
+
+
+@dataclass
+class CrawlJob:
+    name: str
+    loop: SpiderLoop
+    max_pages: int
+    thread: threading.Thread | None = None
+    paused: bool = False
+    done: bool = False
+    error: str = ""
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def status(self) -> dict:
+        st = self.loop.stats
+        return {
+            "name": self.name,
+            "indexed": st.indexed, "fetched": st.fetched,
+            "errors": st.errors, "robots_blocked": st.robots_blocked,
+            "links_found": st.links_found,
+            "frontier": len(self.loop.sched),
+            "maxPages": self.max_pages,
+            "paused": self.paused, "done": self.done,
+            "jobError": self.error,
+        }
+
+
+class CrawlBot:
+    """Registry + runner for REST-created crawl jobs."""
+
+    def __init__(self, colldb, fetcher_factory=None):
+        self.colldb = colldb
+        #: injectable for tests (FakeFetcher); None = real Fetcher
+        self.fetcher_factory = fetcher_factory or Fetcher
+        self.jobs: dict[str, CrawlJob] = {}
+        self._lock = threading.Lock()
+
+    def create(self, name: str, seeds: list[str], max_pages: int = 100,
+               max_hops: int = 3, same_host_only: bool = True,
+               delay_s: float = 0.25) -> CrawlJob:
+        with self._lock:
+            if name in self.jobs:
+                raise ValueError(f"job {name!r} already exists")
+            coll = self.colldb.get(f"crawl_{name}")
+            sched = DurableSpiderScheduler(
+                coll.dir / "spider",
+                filters=[UrlFilterRule("*", delay_s=delay_s)],
+                max_hops=max_hops, same_host_only=same_host_only,
+                banned=coll.tagdb.is_banned)
+            loop = SpiderLoop(coll, scheduler=sched,
+                              fetcher=self.fetcher_factory())
+            job = CrawlJob(name=name, loop=loop, max_pages=max_pages)
+            self.jobs[name] = job
+
+        def run():
+            try:
+                # seed in the background: each new host resolves its
+                # first-IP, which can take seconds — the REST handler
+                # must not hold the server lock through that
+                for u in seeds:
+                    loop.add_url(u)
+                while (not job.done
+                       and job.loop.stats.indexed < job.max_pages
+                       and not job.loop.sched.exhausted):
+                    if job.paused:
+                        time.sleep(0.2)
+                        continue
+                    before = job.loop.stats.fetched
+                    with job.lock:
+                        job.loop.crawl_step()
+                    if job.loop.stats.fetched == before:
+                        # every IP inside its politeness window —
+                        # sleep instead of spinning (SpiderLoop.crawl's
+                        # backoff)
+                        time.sleep(0.05)
+            except Exception as e:  # noqa: BLE001 — surface, don't die
+                job.error = str(e)
+                log.exception("crawl job %s failed", name)
+            finally:
+                job.done = True
+                try:
+                    job.loop.sched.save()
+                    self.colldb.get(f"crawl_{name}").save()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        job.thread = threading.Thread(target=run, daemon=True,
+                                      name=f"crawlbot-{name}")
+        job.thread.start()
+        log.info("crawl job %s started (%d seeds, max %d pages)", name,
+                 len(seeds), max_pages)
+        return job
+
+    def get(self, name: str) -> CrawlJob | None:
+        return self.jobs.get(name)
+
+    def delete(self, name: str) -> bool:
+        """Unregister AND purge the job's corpus + frontier: a
+        recreated job of the same name must start fresh (the durable
+        spiderdb seen-set would otherwise dedup the new seeds away and
+        the job would 'finish' with nothing crawled)."""
+        with self._lock:
+            job = self.jobs.pop(name, None)
+        if job is None:
+            return False
+        job.done = True
+        if job.thread is not None:
+            job.thread.join(5.0)  # let the loop notice before purging
+        try:
+            coll = self.colldb.colls.pop(f"crawl_{name}", None)
+            cdir = coll.dir if coll is not None else None
+            if cdir is None:
+                base = self.colldb.base_dir / "coll" / f"crawl_{name}"
+                cdir = base if base.exists() else None
+            if cdir is not None:
+                shutil.rmtree(cdir, ignore_errors=True)
+        except Exception:  # noqa: BLE001 — purge is best-effort
+            log.exception("crawl job %s purge failed", name)
+        return True
+
+    def list_jobs(self) -> list[dict]:
+        return [j.status() for j in self.jobs.values()]

@@ -223,6 +223,10 @@ class SearchHTTPServer:
         self._statsdb_path = Path(base_dir) / "statsdb.jsonl"
         self._sampler: threading.Thread | None = None
         self._stop_sampling = threading.Event()
+        #: crawlbot job registry (lazy; PageCrawlBot.cpp role) and an
+        #: injectable fetcher factory for tests
+        self._crawlbot = None
+        self.crawl_fetcher_factory = None
         #: AutoBan (AutoBan.cpp): per-IP query rate limiting. hits =
         #: ip → recent request timestamps; banned = ip → ban expiry
         self._ab_lock = threading.Lock()
@@ -315,6 +319,14 @@ class SearchHTTPServer:
                body: bytes) -> tuple[int, str, str]:
         if path == "/get":
             return self._page_get(query)
+        if path == "/crawlbot":
+            # REST bulk-crawl API (PageCrawlBot.cpp) — admin-gated
+            # like every index-mutating endpoint
+            if not self._authorized(query):
+                self.stats["auth_denied"] += 1
+                return 401, json.dumps(
+                    {"error": "bad or missing pwd"}), "application/json"
+            return self._page_crawlbot(query)
         if path in ("/inject", "/addurl"):
             # index-mutating endpoints are admin-gated once a master
             # password is set (the reference gates injection behind the
@@ -486,6 +498,46 @@ class SearchHTTPServer:
         self.spider.add_url(url)
         return 200, json.dumps({"queued": url}), "application/json"
 
+    def _page_crawlbot(self, query: dict) -> tuple[int, str, str]:
+        """REST crawl jobs (PageCrawlBot.cpp): create/status/pause/
+        resume/delete; corpora search via /search?c=crawl_<name>."""
+        from .crawlbot import CrawlBot
+        if self._crawlbot is None:
+            self._crawlbot = CrawlBot(self.colldb,
+                                      fetcher_factory=
+                                      self.crawl_fetcher_factory)
+        bot = self._crawlbot
+        name = query.get("name", "")
+        if not name:
+            return 200, json.dumps({"jobs": bot.list_jobs()}),                 "application/json"
+        action = query.get("action", "")
+        if action in ("pause", "resume"):
+            job = bot.get(name)
+            if job is None:
+                return 404, json.dumps({"error": "no such job"}),                     "application/json"
+            job.paused = action == "pause"
+            return 200, json.dumps(job.status()), "application/json"
+        if action == "delete":
+            ok = bot.delete(name)
+            return (200 if ok else 404), json.dumps({"deleted": ok}),                 "application/json"
+        seeds = [u for u in (query.get("seeds", "") or "").replace(
+            ",", " ").split() if u]
+        if seeds:
+            try:
+                job = bot.create(
+                    name, seeds,
+                    max_pages=int(query.get("maxpages", 100)),
+                    max_hops=int(query.get("maxhops", 3)),
+                    same_host_only=query.get("spanhosts", "0")
+                    not in ("1", "true"))
+            except ValueError as e:
+                return 409, json.dumps({"error": str(e)}),                     "application/json"
+            return 200, json.dumps(job.status()), "application/json"
+        job = bot.get(name)
+        if job is None:
+            return 404, json.dumps({"error": "no such job"}),                 "application/json"
+        return 200, json.dumps(job.status()), "application/json"
+
     def _page_parms(self, query: dict) -> tuple[int, str, str]:
         """Parameter view + live update via cgi names — the Parms URL api
         (``&maxmem=...``); updates fire the conf's on_update listeners
@@ -531,10 +583,31 @@ class SearchHTTPServer:
                 f"</body></html>")
 
     def _page_profiler(self, query: dict) -> tuple[int, str, str]:
-        """Per-stage timing table (the Profiler.cpp role, realized as
-        the engine's own stage spans: prepare/pack/score/device/
-        results/waves)."""
+        """Per-stage timing table + on-demand SAMPLING profiler (the
+        two halves of the Profiler.cpp role: the message-latency stats
+        and the realtime stack sampler started/stopped from the admin
+        page — ``startRealTimeProfiler``, ``Profiler.cpp:1586``).
+
+        ``?sample=start|stop|reset`` controls the sampler;
+        ``?sample=report`` (or format=json with the sampler running)
+        returns the aggregated stack histogram."""
+        from ..utils.profiler import g_profiler
         from ..utils.stats import g_stats
+        action = query.get("sample", "")
+        if action == "start":
+            g_profiler.start()
+            return 200, json.dumps({"sampling": True}), \
+                "application/json"
+        if action == "stop":
+            g_profiler.stop()
+            return 200, json.dumps(g_profiler.report()), \
+                "application/json"
+        if action == "reset":
+            g_profiler.reset()
+            return 200, json.dumps({"reset": True}), "application/json"
+        if action == "report":
+            return 200, json.dumps(g_profiler.report()), \
+                "application/json"
         snap = g_stats.snapshot()
         if query.get("format") == "json":
             return 200, json.dumps(snap["latencies"]), "application/json"

@@ -48,3 +48,16 @@ def test_inject_search_save_restart(tmp_path):
     out = run_cli(tmp_path, "search", "--dir", "d", "banana", "--json")
     assert out["total"] == 1
     assert out["results"][0]["url"] == "http://cli.test/b"
+
+
+def test_proxy_mode_registered():
+    """gb proxy (main.cpp:1691): the CLI exposes the front-proxy mode."""
+    import subprocess
+    import sys
+    out = subprocess.run(
+        [sys.executable, "-m", "open_source_search_engine_tpu",
+         "proxy", "--help"],
+        capture_output=True, text=True, timeout=60,
+        env={"PYTHONPATH": ".", "JAX_PLATFORMS": "cpu",
+             "PATH": "/usr/bin:/bin"})
+    assert out.returncode == 0 and "cluster" in out.stdout

@@ -1,0 +1,88 @@
+"""Crawlbot REST API (PageCrawlBot.cpp): create a crawl job over REST,
+watch status, search the crawled corpus, pause/delete."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from open_source_search_engine_tpu.serve.server import SearchHTTPServer
+from open_source_search_engine_tpu.spider.fetcher import (Fetcher,
+                                                          FetchResult)
+
+PAGES = {
+    "http://cb.test/": "<html><body><p>crawlbot start page "
+                       '<a href="/a">a</a> <a href="/b">b</a>'
+                       "</p></body></html>",
+    "http://cb.test/a": "<html><body><p>crawlbot alpha words"
+                        "</p></body></html>",
+    "http://cb.test/b": "<html><body><p>crawlbot beta words"
+                        "</p></body></html>",
+}
+
+
+class FakeFetcher(Fetcher):
+    def __init__(self):
+        super().__init__(cache_ttl_s=0)
+
+    def fetch_many(self, urls, **kw):
+        return [FetchResult(url=u, status=200,
+                            content=PAGES.get(u.rstrip("/") if
+                                              u.rstrip("/") in PAGES
+                                              else u, ""),
+                            content_type="text/html") for u in urls]
+
+
+@pytest.fixture
+def srv(tmp_path):
+    s = SearchHTTPServer(tmp_path, port=0)
+    s.crawl_fetcher_factory = FakeFetcher
+    s.start()
+    yield s
+    s.stop()
+
+
+def _get(srv, path):
+    try:
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv._httpd.server_port}{path}")
+        return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_crawlbot_lifecycle(srv):
+    st, body = _get(srv, "/crawlbot")
+    assert st == 200 and body["jobs"] == []
+    st, body = _get(srv, "/crawlbot?name=shop&seeds=http://cb.test/"
+                         "&maxpages=10&maxhops=3")
+    assert st == 200 and body["name"] == "shop"
+    # duplicate create → 409
+    st, _ = _get(srv, "/crawlbot?name=shop&seeds=http://cb.test/")
+    assert st == 409
+    for _ in range(100):
+        st, body = _get(srv, "/crawlbot?name=shop")
+        if body["done"]:
+            break
+        time.sleep(0.2)
+    assert body["indexed"] == 3 and body["links_found"] >= 2
+    # the crawled corpus answers through the normal search surface
+    st, res = _get(srv, "/search?q=crawlbot+alpha&c=crawl_shop"
+                        "&format=json")
+    assert st == 200 and res["totalMatches"] == 1
+    assert res["results"][0]["url"] == "http://cb.test/a"
+    st, body = _get(srv, "/crawlbot?name=shop&action=delete")
+    assert st == 200 and body["deleted"]
+    st, _ = _get(srv, "/crawlbot?name=shop")
+    assert st == 404
+
+
+def test_crawlbot_requires_auth_when_password_set(srv):
+    srv.conf.master_password = "pw"
+    st, _ = _get(srv, "/crawlbot")
+    assert st == 401
+    st, body = _get(srv, "/crawlbot?pwd=pw")
+    assert st == 200
+    srv.conf.master_password = ""
