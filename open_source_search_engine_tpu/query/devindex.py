@@ -420,6 +420,9 @@ class ResidentPlan:
     #: match gate) — the MESH layer passes the cross-shard minimum so
     #: per-shard keys stay comparable under the Msg3a merge
     sort_base: float = 0.0
+    #: number of scored∧required groups (the single definition every
+    #: routing/k2/κ decision keys on)
+    n_scored: int = 0
 
 
 class DeviceIndex:
@@ -1194,6 +1197,7 @@ class DeviceIndex:
             table=pad_table(qplan.bool_table),
             qlang=qplan.lang, matchable=matchable,
             driver_df=0 if driver_df == 1 << 60 else int(driver_df),
+            n_scored=int(np.sum(counts)),
             direct_ok=direct_ok, g_quarter=g_quarter, g_qsyn=g_qsyn,
             has_table=qplan.bool_table is not None,
             filters=tuple(sorted(
@@ -1241,6 +1245,19 @@ class DeviceIndex:
 
         def _route_f2(i):
             p = plans[i]
+            if (p.n_scored <= 1 and not p.has_table
+                    and len(p.s_start) <= 16):
+                # single-scored-group with bounded sparse rows: the
+                # phase-1 bound IS the exact single-term score (exact
+                # impacts), so F1's top-κ-by-bound is exact ordering at
+                # ANY driver df — κ=256 with a 128-wide phase 2 beats
+                # full-corpus scoring ~4× per query, and the lossless
+                # check still backstops it. The Rs cap keeps the wave
+                # inside warmed buckets: a heavy term WITHOUT a dense
+                # slot (possible at big shards, where the dense budget
+                # caps slots) would otherwise mint an unwarmed
+                # Rs=128/256 shape and slow every co-batched lane.
+                return False
             if p.driver_df > f2_cut:
                 return True
             # heavy multi-group queries that CAN go direct should: the
@@ -1248,7 +1265,7 @@ class DeviceIndex:
             # distance-free bounds (escalation-prone); the direct
             # kernel scores the whole corpus exactly at flat cost and
             # never rungs up
-            return (p.direct_ok and int(np.sum(p.counts)) > 1
+            return (p.direct_ok and p.n_scored > 1
                     and self._kappa_of(p, topk) >= 8 * KAPPA_FLOOR)
 
         f2 = [i for i in live if _route_f2(i)]
@@ -1286,7 +1303,7 @@ class DeviceIndex:
                 # bound order ≉ exact order and truncation would
                 # escalate nearly every query (measured 57%). Multi-
                 # group plans score every selected candidate.
-                if int(np.sum(plans[i].counts)) <= 1:
+                if plans[i].n_scored <= 1:
                     k2i = min(max(k2v, plans[i].k2_min), kapi)
                 else:
                     k2i = kapi
@@ -1531,7 +1548,7 @@ class DeviceIndex:
         query. Multi-group queries rung by driver_df as before: their
         pair bounds are distance-free (loose), and a small κ would
         escalate every time."""
-        if int(np.sum(p.counts)) <= 1:
+        if p.n_scored <= 1:
             # top-MAX_TOP-cut impacts make the single-group bound the
             # exact score (mod float association): the smallest rung
             # suffices and phase-2 cost collapses to κ=256 gathers
