@@ -262,8 +262,9 @@ def main() -> None:
     # — a ratio ≪ 1 means the pass is latency/RTT-bound, not BW-bound
     res_bytes = sum(
         int(np.prod(a.shape)) * a.dtype.itemsize
-        for a in (di.d_payload, di.d_doc, di.d_imp, di.d_rsp,
-                  di.d_dense_imp, di.d_dense_rsp, di.d_cube))
+        for a in (di.d_payload, di.d_doc, di.d_imp, di.d_rs, di.d_cnt,
+                  di.d_dense_imp, di.d_dense_rs, di.d_dense_cnt,
+                  di.d_cube))
     n_waves = sum(v["count"] for k, v in snap.get(
         "latencies", {}).items() if k.startswith("devindex.wave"))
     print(f"# resident index: {res_bytes / 1e9:.2f} GB in HBM; "

@@ -77,10 +77,11 @@ dynamic-update-slice — O(memtable) transfer, no O(corpus) copies, no
 double residency. Document frequencies stay exact under deletes via the
 tombstone-pair subtraction (the Msg36/37 termfreq role).
 
-Capacity: run starts pack into 26 bits (count in the low 5 of an
-int32), capping a shard at 2^26 ≈ 67M stored postings (~500k web
-pages) — beyond that the corpus must shard (``parallel/``), same as
-the reference's per-host index splits.
+Capacity: run starts are full int32 column offsets (counts ride a
+separate uint8 column), so the pack limit is 2^31 stored postings and
+HBM binds first — a 16 GB v5e holds roughly 1.3M web pages' columns
+plus dense/cube rows. Beyond that the corpus must shard
+(``parallel/``), same as the reference's per-host index splits.
 """
 
 from __future__ import annotations
@@ -134,11 +135,16 @@ DENSE_MIN_DF = 1024
 #: degrade linearly instead of rectangularly
 LSP_MAX = 2048
 
-#: HBM budget for materialized [P, D_cap] cube rows (P·4 bytes/doc/term)
-#: — sized so most corpus-wide drivers resolve through the direct
-#: quarter-gather kernel instead of the assembling F2 (v5e has 16 GB;
-#: dense rows take ~1.5 GB, columns ~0.5 GB, working set ~2 GB)
-CUBE_BUDGET_BYTES = 3 << 30
+#: HARD CAP for materialized [P, D_cap] cube rows (P·4 bytes/doc/term)
+#: — the actual budget is adaptive: after columns + dense rows claim
+#: their bytes, the cube gets what HBM can spare (more cube rows →
+#: more corpus-wide drivers resolve through the flat-cost direct
+#: kernel instead of the assembling F2)
+CUBE_BUDGET_BYTES = 5 << 30
+#: usable HBM for the resident set (v5e 16 GB minus XLA/runtime slack)
+HBM_USABLE_BYTES = 13 << 30
+#: head-room reserved for wave intermediates next to the resident set
+WAVE_RESERVE_BYTES = 5 << 29
 
 #: direct-kernel scatter tail budget: total non-cube postings a query
 #: may scatter into its quarter-built plane before falling back to the
@@ -163,9 +169,16 @@ RP_FLOOR = 4
 #: posting/doc column padding quantum
 COL_QUANTUM = 1 << 15
 
-_RS_SHIFT = 5          # runstart<<5 | count  (count ≤ MAX_POSITIONS=16)
-_CNT_MASK = 31
-_MAX_POSTINGS = 1 << (31 - _RS_SHIFT)  # int32 rs|cnt pack limit (2^26)
+#: run starts and counts live in SEPARATE columns (int32 runstart +
+#: uint8 count) — the former rs<<5|cnt int32 pack capped a shard at
+#: 2^26 stored postings (~500k pages); split, the pack limit is the
+#: int32 index space and HBM binds first (~1.3M pages on a 16 GB v5e)
+_MAX_POSTINGS = 1 << 31
+#: posting doc+occurrence pack: docidx<<4 | occ in one uint32 (occ <
+#: MAX_POSITIONS = 16 → 4 bits; doc capacity 2^28) — one gather feeds
+#: both fields in the F2/FD scatter paths
+_OCC_BITS = 4
+_OCC_MASK = 15
 
 #: escalation tie tolerance (× the 1e-5 admissibility inflation)
 _TIE_TOL = 1.0001
@@ -317,12 +330,12 @@ def _block_top2(x, n_sel: int):
 
 
 @partial(jax.jit, static_argnames=("V", "D", "n_lanes"))
-def _build_dense_rows(d_doc, d_imp, d_rsp, starts, cum,
+def _build_dense_rows(d_doc, d_imp, d_rs, d_cnt, starts, cum,
                       V: int, D: int, n_lanes: int):
-    """Dense [V, D] impact + runstart rows, built by one flattened
-    scatter over the doc-pair columns. Lane → row via searchsorted on
-    the cumulative-length table; everything stays on device — the host
-    ships only (starts, cum), a few KB."""
+    """Dense [V, D] impact + runstart + count rows, built by one
+    flattened scatter over the doc-pair columns. Lane → row via
+    searchsorted on the cumulative-length table; everything stays on
+    device — the host ships only (starts, cum), a few KB."""
     R = starts.shape[0]
     lane = jnp.arange(n_lanes, dtype=jnp.int32)
     row = jnp.clip(jnp.searchsorted(cum, lane, side="right") - 1,
@@ -331,13 +344,15 @@ def _build_dense_rows(d_doc, d_imp, d_rsp, starts, cum,
                    d_doc.shape[0] - 1)
     valid = lane < cum[-1]
     doc = d_doc[src].astype(jnp.int32)
-    # dst fits int32: V·D ≤ DENSE_BUDGET/8 < 2^31
+    # dst fits int32: V·D ≤ DENSE_BUDGET/9 < 2^31
     dst = jnp.where(valid, row * D + doc, V * D)
     imp = jnp.zeros((V * D,), jnp.float32).at[dst].set(
         d_imp[src], mode="drop")
-    rsp = jnp.zeros((V * D,), jnp.int32).at[dst].set(
-        d_rsp[src], mode="drop")
-    return imp.reshape(V, D), rsp
+    rs = jnp.zeros((V * D,), jnp.int32).at[dst].set(
+        d_rs[src], mode="drop")
+    cnt = jnp.zeros((V * D,), jnp.uint8).at[dst].set(
+        d_cnt[src], mode="drop")
+    return imp.reshape(V, D), rs, cnt
 
 
 @partial(jax.jit, static_argnames=("total",))
@@ -436,6 +451,10 @@ class DeviceIndex:
         #: shards execute concurrently on N chips
         self.device = device
         self.coll = coll
+        if max_positions > (1 << _OCC_BITS):
+            raise ValueError(
+                f"max_positions > {1 << _OCC_BITS} overflows the 4-bit "
+                "occurrence field of the docc pack")
         self.P = max_positions
         self._built_version = -1
         self._base_fp = None
@@ -485,7 +504,7 @@ class DeviceIndex:
         return True
 
     #: bump when any derived-column computation changes (cache schema)
-    _CACHE_SCHEMA = 3  # v3: exact (per-inlink-occurrence) impacts
+    _CACHE_SCHEMA = 4  # v4: split rs/cnt columns (2^26 cap lifted)
 
     def _cache_path(self, fp):
         import hashlib
@@ -506,12 +525,13 @@ class DeviceIndex:
             return tuple(z[k] for k in (
                 "dir_termids", "base_df", "dir_dstart", "dir_pstart",
                 "base_docids", "docidx", "pocc", "payload", "doc_col",
-                "imp_col", "rsp_col", "siterank", "langid"))
+                "imp_col", "rs_col", "cnt_col", "siterank", "langid"))
         except Exception:  # torn write etc. — recompute
             return None
 
     def _save_base_cache(self, fp, docidx, pocc, payload, doc_col,
-                         imp_col, rsp_col, siterank, langid) -> None:
+                         imp_col, rs_col, cnt_col, siterank,
+                         langid) -> None:
         p = self._cache_path(fp)
         p.parent.mkdir(parents=True, exist_ok=True)
         for old in p.parent.glob("base_*.npz"):
@@ -522,7 +542,8 @@ class DeviceIndex:
                  dir_pstart=self.dir_pstart,
                  base_docids=self.base_docids, docidx=docidx, pocc=pocc,
                  payload=payload, doc_col=doc_col, imp_col=imp_col,
-                 rsp_col=rsp_col, siterank=siterank, langid=langid)
+                 rs_col=rs_col, cnt_col=cnt_col, siterank=siterank,
+                 langid=langid)
         tmp.rename(p)
 
     def _build_base(self, fp, min_docs: int = 0, min_delta: int = 0
@@ -536,7 +557,8 @@ class DeviceIndex:
         if cached is not None:
             (self.dir_termids, self.base_df, self.dir_dstart,
              self.dir_pstart, self.base_docids, docidx, pocc, payload,
-             doc_col, imp_col, rsp_col, siterank, langid) = cached
+             doc_col, imp_col, rs_col, cnt_col, siterank,
+             langid) = cached
             n = len(docidx)
             batch = None
         else:
@@ -573,8 +595,8 @@ class DeviceIndex:
             doc_col = docidx[newpair]
             count = np.diff(np.r_[runstart, n])
             imp_col = _impacts_np(f, termids, docidx, runstart)
-            rsp_col = ((runstart << _RS_SHIFT)
-                       | np.minimum(count, P)).astype(np.int32)
+            rs_col = runstart.astype(np.int32)
+            cnt_col = np.minimum(count, P).astype(np.uint8)
             tchange = np.ones(n, bool)
             tchange[1:] = termids[1:] != termids[:-1]
             tstarts = np.nonzero(tchange)[0]
@@ -585,7 +607,8 @@ class DeviceIndex:
             siterank = f["siterank"].astype(np.int32)
             langid = f["langid"].astype(np.int32)
             self._save_base_cache(fp, docidx, pocc, payload, doc_col,
-                                  imp_col, rsp_col, siterank, langid)
+                                  imp_col, rs_col, cnt_col, siterank,
+                                  langid)
         else:
             self.dir_termids = np.empty(0, np.uint64)
             self.base_df = np.empty(0, np.int64)
@@ -597,13 +620,18 @@ class DeviceIndex:
             payload = np.empty(0, np.uint32)
             doc_col = np.empty(0, np.int32)
             imp_col = np.empty(0, np.float32)
-            rsp_col = np.empty(0, np.int32)
+            rs_col = np.empty(0, np.int32)
+            cnt_col = np.empty(0, np.uint8)
             siterank = langid = np.empty(0, np.int32)
             n = 0
 
         Db = len(self.base_docids)
         headroom = max(1024, Db // 4)
         self.D_cap = _bucket(max(Db + headroom, min_docs, 1), DOC_QUANTUM)
+        if self.D_cap > (1 << 28):
+            # docc pack ships docidx in the high 28 bits of a uint32
+            raise ValueError(
+                "docc pack caps a shard at 2^28 docs — shard the corpus")
 
         # --- doc meta table (first posting per doc supplies siterank/
         # langid — reference getSiteRank(miniMergedList[0]), 6989) ---
@@ -621,7 +649,8 @@ class DeviceIndex:
         # the host link; the descriptors below are a few KB) ---
         dfs = np.diff(self.dir_dstart)
         tau = max(DENSE_MIN_DF, self.D_cap // 64)
-        slots_budget = max(DENSE_BUDGET_BYTES // (8 * self.D_cap), 1)
+        # 9 bytes per (term, doc) slot: f32 impact + int32 rs + u8 cnt
+        slots_budget = max(DENSE_BUDGET_BYTES // (9 * self.D_cap), 1)
         eligible = np.nonzero(dfs > tau)[0]
         eligible = eligible[np.argsort(-dfs[eligible], kind="stable")]
         dense_terms = eligible[:slots_budget]
@@ -639,7 +668,19 @@ class DeviceIndex:
         # materialized so the full-cube kernel (F2) reads them as plain
         # slices. Built device-side by one scatter from the posting
         # columns — no multi-hundred-MB host upload ---
-        cube_budget = max(CUBE_BUDGET_BYTES // (P * self.D_cap * 4), 1)
+        # adaptive budget: columns + dense rows are obligatory; the
+        # cube takes what HBM can spare up to the hard cap
+        nb_est = _bucket(max(n, 1), COL_QUANTUM)
+        mb_est = _bucket(max(len(doc_col), 1), COL_QUANTUM)
+        n2_est = max(_bucket(max(nb_est // 4, min_delta, 1),
+                             COL_QUANTUM), COL_QUANTUM)
+        cols_bytes = (nb_est + n2_est) * 8 + (mb_est + n2_est) * 13
+        dense_bytes = V * self.D_cap * 9
+        cube_bytes = min(
+            CUBE_BUDGET_BYTES,
+            max(1 << 30, HBM_USABLE_BYTES - cols_bytes - dense_bytes
+                - WAVE_RESERVE_BYTES))
+        cube_budget = max(cube_bytes // (P * self.D_cap * 4), 1)
         cube_terms = dense_terms[:cube_budget]
         # +1: the last slot stays all-zero — the FD kernel's "absent
         # quarter" target (zero payload = invalid by convention)
@@ -667,14 +708,17 @@ class DeviceIndex:
         self.M2 = self.N2
         self.d_payload = self._put(
             _pad_col(payload, self.Nb + self.N2))
-        self.d_pdoc = self._put(_pad_col(docidx, self.Nb + self.N2))
-        self.d_pocc = self._put(_pad_col(pocc, self.Nb + self.N2))
+        docc = ((docidx.astype(np.uint32) << _OCC_BITS)
+                | pocc.astype(np.uint32))
+        self.d_docc = self._put(_pad_col(docc, self.Nb + self.N2))
         self.d_doc = self._put(_pad_col(doc_col, self.Mb + self.M2))
         self.d_imp = self._put(_pad_col(imp_col, self.Mb + self.M2))
-        self.d_rsp = self._put(_pad_col(rsp_col, self.Mb + self.M2))
+        self.d_rs = self._put(_pad_col(rs_col, self.Mb + self.M2))
+        self.d_cnt = self._put(_pad_col(cnt_col, self.Mb + self.M2))
         dr_cum = np.r_[0, np.cumsum(dr_lens)].astype(np.int32)
-        self.d_dense_imp, self.d_dense_rsp = _build_dense_rows(
-            self.d_doc, self.d_imp, self.d_rsp,
+        (self.d_dense_imp, self.d_dense_rs,
+         self.d_dense_cnt) = _build_dense_rows(
+            self.d_doc, self.d_imp, self.d_rs, self.d_cnt,
             self._put(dr_starts), self._put(dr_cum),
             V=V, D=self.D_cap,
             n_lanes=_bucket(max(int(dr_cum[-1]), 1), COL_QUANTUM))
@@ -798,8 +842,8 @@ class DeviceIndex:
             imp2 = _impacts_np(fp_, fp_["termid"], docidx, runstart2)
             # runstarts reference the combined column: delta postings
             # live at [Nb, Nb + n2)
-            rsp2 = (((self.Nb + runstart2) << _RS_SHIFT)
-                    | np.minimum(count2, self.P)).astype(np.int32)
+            rs2 = (self.Nb + runstart2).astype(np.int32)
+            cnt2 = np.minimum(count2, self.P).astype(np.uint8)
             tchange = np.ones(n2, bool)
             tchange[1:] = fp_["termid"][1:] != fp_["termid"][:-1]
             tstarts = np.nonzero(tchange)[0]
@@ -819,11 +863,10 @@ class DeviceIndex:
                 self.d_payload,
                 self._put(_pad_col(payload2, self.N2)),
                 np.int32(self.Nb))
-            self.d_pdoc = _write_tail(
-                self.d_pdoc, self._put(_pad_col(docidx, self.N2)),
-                np.int32(self.Nb))
-            self.d_pocc = _write_tail(
-                self.d_pocc, self._put(_pad_col(pocc2, self.N2)),
+            docc2 = ((docidx.astype(np.uint32) << _OCC_BITS)
+                     | pocc2.astype(np.uint32))
+            self.d_docc = _write_tail(
+                self.d_docc, self._put(_pad_col(docc2, self.N2)),
                 np.int32(self.Nb))
             self.d_doc = _write_tail(
                 self.d_doc, self._put(_pad_col(doc2_col, self.M2)),
@@ -831,8 +874,11 @@ class DeviceIndex:
             self.d_imp = _write_tail(
                 self.d_imp, self._put(_pad_col(imp2, self.M2)),
                 np.int32(self.Mb))
-            self.d_rsp = _write_tail(
-                self.d_rsp, self._put(_pad_col(rsp2, self.M2)),
+            self.d_rs = _write_tail(
+                self.d_rs, self._put(_pad_col(rs2, self.M2)),
+                np.int32(self.Mb))
+            self.d_cnt = _write_tail(
+                self.d_cnt, self._put(_pad_col(cnt2, self.M2)),
                 np.int32(self.Mb))
         else:
             self._set_empty_delta()
@@ -871,9 +917,10 @@ class DeviceIndex:
         import numpy as _np
         return sum(
             int(_np.prod(a.shape)) * a.dtype.itemsize
-            for a in (self.d_payload, self.d_pdoc, self.d_pocc,
-                      self.d_doc, self.d_imp, self.d_rsp,
-                      self.d_dense_imp, self.d_dense_rsp, self.d_cube,
+            for a in (self.d_payload, self.d_docc,
+                      self.d_doc, self.d_imp, self.d_rs, self.d_cnt,
+                      self.d_dense_imp, self.d_dense_rs,
+                      self.d_dense_cnt, self.d_cube,
                       self.d_siterank, self.d_doclang, self.d_dead))
 
     def _docid_pos(self, docids_arr: np.ndarray) -> tuple[np.ndarray,
@@ -1562,11 +1609,12 @@ class DeviceIndex:
 
     def _f1_bmax(self) -> int:
         """Largest F1 wave B the HBM budget allows (power of two ≤ 64):
-        phase-1 intermediates run ~176·D bytes per lane (the [2, T, D]
-        scatter target plus the [T, D] bound chains) — at 100k docs
-        B=64 fits easily; at the 500k-doc shard cap it must drop or the
-        wave OOMs next to the ~7 GB resident set."""
-        cap = max(4, (2 << 30) // (176 * self.D_cap))
+        phase-1 intermediates run ~128·D bytes per lane (the single
+        [T, D] scatter target — base dead-masking happens at gather
+        time — plus the [T, D] bound chains) — at 100k docs B=64 fits
+        easily; at a 1M-doc shard it must drop or the wave OOMs next
+        to the ~9 GB resident set."""
+        cap = max(4, (2 << 30) // (128 * self.D_cap))
         b = 4
         while b * 2 <= cap and b < 64:
             b *= 2
@@ -1665,8 +1713,9 @@ class DeviceIndex:
         # (each separate blocking fetch costs a full ~100 ms tunnel RTT)
         d_filter, d_sort, uf, us = self._filter_sort_cols(plans[0])
         return _two_phase(
-            self.d_payload, self.d_doc, self.d_imp, self.d_rsp,
-            self.d_dense_imp, self.d_dense_rsp,
+            self.d_payload, self.d_doc, self.d_imp, self.d_rs,
+            self.d_cnt, self.d_dense_imp, self.d_dense_rs,
+            self.d_dense_cnt,
             self.d_siterank, self.d_doclang, self.d_dead,
             np.int32(self.n_docs), d_filter, d_sort, sel, *args,
             n_positions=self.P, lsp=Lsp, kappa=kappa, k2=k2,
@@ -1721,8 +1770,8 @@ class DeviceIndex:
                   B, Rc, Rp, Lp, k2, n_sel)
         d_filter, d_sort, uf, us = self._filter_sort_cols(plans[0])
         return _full_cube(
-            self.d_payload, self.d_pdoc, self.d_pocc, self.d_cube,
-            self.d_dense_rsp, self.d_siterank, self.d_doclang,
+            self.d_payload, self.d_docc, self.d_cube,
+            self.d_dense_cnt, self.d_siterank, self.d_doclang,
             self.d_dead, np.int32(self.n_docs), d_filter, d_sort,
             *args,
             n_positions=self.P, lpost=Lp, k2=k2,
@@ -1783,7 +1832,7 @@ class DeviceIndex:
                   B, T, Rp, Lp, k2, n_sel)
         d_filter, d_sort, uf, us = self._filter_sort_cols(plans[0])
         return _direct_cube(
-            self.d_cube, self.d_payload, self.d_pdoc, self.d_pocc,
+            self.d_cube, self.d_payload, self.d_docc,
             self.d_siterank, self.d_doclang, self.d_dead,
             np.int32(self.n_docs), d_filter, d_sort, cs, sy, *args,
             n_positions=self.P, lpost=Lp, k2=k2,
@@ -1800,7 +1849,8 @@ def _apply_doc_meta(sr, dl, idx, vsr, vdl):
 @partial(jax.jit, static_argnames=("n_positions", "lsp", "kappa", "k2",
                                    "use_table", "use_filter",
                                    "use_sort"))
-def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
+def _two_phase(d_payload, d_doc, d_imp, d_rs, d_cnt,
+               d_dense_imp, d_dense_rs, d_dense_cnt,
                d_siterank, d_doclang, d_dead, n_docs_total,
                d_filter, d_sort, d_sel,
                d_slot, d_group, d_base, d_quota, d_syn,
@@ -1845,33 +1895,36 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
         t_ax = jnp.arange(T)
         live = ~d_dead                                        # [D]
 
-        # ---- phase 1: group upper bounds over the full doc axis,
-        # base and delta separated so dead docs mask only the base
+        # ---- phase 1: group upper bounds over the full doc axis
         # (dense-row part arrives precomputed from the batch matmul) ----
         dgate = (d_slot >= 0)
         # sparse rows: one fused contiguous gather + bounded scatter-add
-        # into [2 (base/delta), T, D] — lane count is the real run size
+        # into [T, D]. Base-row lanes of dead docs zero at GATHER time
+        # (a [Rs, Lsp] gather of the dead vector) so base and delta
+        # share one scatter target — half the [2, T, D] footprint the
+        # former base/delta target split paid per lane
         lane = jnp.arange(lsp, dtype=jnp.int32)
         sidx = s_start[:, None] + lane[None, :]               # [Rs, Lsp]
         smask = lane[None, :] < s_len[:, None]
         sidxc = jnp.clip(sidx, 0, M - 1)
         sdoc = d_doc[sidxc]
         simp = d_imp[sidxc]
-        srsp = d_rsp[sidxc]
-        side = jnp.where(s_isbase, 0, T * D)[:, None]         # [Rs, 1]
-        tgt = jnp.where(smask, side + s_group[:, None] * D + sdoc,
-                        2 * T * D)
-        ub2 = jnp.zeros((2 * T * D,), jnp.float32).at[tgt.ravel()].add(
-            jnp.where(smask, simp, 0.0).ravel(), mode="drop"
-        ).reshape(2, T, D)
-        ubb = ubb + ub2[0]
-        ubd = ub2[1]
-        ub = ubb * live[None, :] + ubd                        # [T, D]
+        srs = d_rs[sidxc]
+        scnt = d_cnt[sidxc]
+        sdead = d_dead[jnp.clip(sdoc, 0, D - 1)]              # [Rs, Lsp]
+        skeep = smask & ~(s_isbase[:, None] & sdead)
+        tgt = jnp.where(skeep, s_group[:, None] * D + sdoc, T * D)
+        ubs = jnp.zeros((T * D,), jnp.float32).at[tgt.ravel()].add(
+            jnp.where(skeep, simp, 0.0).ravel(), mode="drop"
+        ).reshape(T, D)
+        ub = ubb * live[None, :] + ubs                        # [T, D]
         rstgt = jnp.where(
             smask, jnp.arange(Rs, dtype=jnp.int32)[:, None] * D + sdoc,
             Rs * D)
         rsacc = jnp.zeros((Rs * D,), jnp.int32).at[rstgt.ravel()].set(
-            jnp.where(smask, srsp, 0).ravel(), mode="drop")
+            jnp.where(smask, srs, 0).ravel(), mode="drop")
+        cntacc = jnp.zeros((Rs * D,), jnp.uint8).at[rstgt.ravel()].set(
+            jnp.where(smask, scnt, jnp.uint8(0)).ravel(), mode="drop")
 
         # intersection + admissible min bound
         present = ub > 0.0                                    # [T, D]
@@ -1960,9 +2013,9 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
         cube = jnp.zeros((T, P, kap2), jnp.uint32)
         pv = jnp.zeros((T, P, kap2), bool)
 
-        def add_row(cube, pv, rsp_c, group, base, quota, syn, is_base):
-            rs = (rsp_c >> _RS_SHIFT).astype(jnp.int32)       # [κ]
-            cnt = rsp_c & _CNT_MASK
+        def add_row(cube, pv, rs, cnt_c, group, base, quota, syn,
+                    is_base):
+            cnt = cnt_c.astype(jnp.int32)                     # [κ]
             cnt = jnp.where(is_base & dead_c, 0, cnt)
             q = p_ax - base                                   # [P, κ]
             sel = (q >= 0) & (q < jnp.minimum(cnt, quota)[None, :])
@@ -1975,16 +2028,19 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
             pv = pv | (sel[None] & gmask)
             return cube, pv
 
-        dense_rsp_c = d_dense_rsp[
-            jnp.clip(d_slot, 0, V - 1)[:, None] * D + cand[None, :]]
+        dslotc = jnp.clip(d_slot, 0, V - 1)[:, None] * D + cand[None, :]
+        dense_rs_c = d_dense_rs[dslotc]
+        dense_cnt_c = d_dense_cnt[dslotc]
         for r in range(Rd):
-            rsp_c = jnp.where(dgate[r], dense_rsp_c[r], 0)
-            cube, pv = add_row(cube, pv, rsp_c, d_group[r], d_base[r],
-                               d_quota[r], d_syn[r], True)
+            rs_c = jnp.where(dgate[r], dense_rs_c[r], 0)
+            cnt_c = jnp.where(dgate[r], dense_cnt_c[r], jnp.uint8(0))
+            cube, pv = add_row(cube, pv, rs_c, cnt_c, d_group[r],
+                               d_base[r], d_quota[r], d_syn[r], True)
         for r in range(Rs):
-            rsp_c = rsacc[r * D + cand]
-            cube, pv = add_row(cube, pv, rsp_c, s_group[r], s_base[r],
-                               s_quota[r], s_syn[r], s_isbase[r])
+            cube, pv = add_row(cube, pv, rsacc[r * D + cand],
+                               cntacc[r * D + cand], s_group[r],
+                               s_base[r], s_quota[r], s_syn[r],
+                               s_isbase[r])
 
         min_sc, present2 = min_scores(cube, pv, freqw, sc)
         req_ok2 = jnp.all(jnp.where(required[:, None], present2, True),
@@ -2022,7 +2078,7 @@ def _two_phase(d_payload, d_doc, d_imp, d_rsp, d_dense_imp, d_dense_rsp,
 @partial(jax.jit, static_argnames=("n_positions", "lpost", "k2", "n_sel",
                                    "use_table", "use_filter",
                                    "use_sort"))
-def _full_cube(d_payload, d_pdoc, d_pocc, d_cube, d_dense_rsp,
+def _full_cube(d_payload, d_docc, d_cube, d_dense_cnt,
                d_siterank, d_doclang, d_dead, n_docs_total,
                d_filter, d_sort,
                c_slot, c_dslot, c_group, c_base, c_quota, c_syn,
@@ -2060,15 +2116,15 @@ def _full_cube(d_payload, d_pdoc, d_pocc, d_cube, d_dense_rsp,
         pv = jnp.zeros((T, P, D), bool)
         # materialized cube rows: slice + count-mask (cube rows are
         # always base postings, so the dead vector masks them)
-        V = d_dense_rsp.shape[0] // D
+        V = d_dense_cnt.shape[0] // D
         for r in range(Rc):
             gate = c_slot[r] >= 0
             row = jax.lax.dynamic_slice(
                 d_cube, (jnp.clip(c_slot[r], 0, VcPD // (P * D) - 1)
                          * P * D,), (P * D,)).reshape(P, D)
-            cnt = (jax.lax.dynamic_slice(
-                d_dense_rsp, (jnp.clip(c_dslot[r], 0, V - 1) * D,),
-                (D,)) & _CNT_MASK)
+            cnt = jax.lax.dynamic_slice(
+                d_dense_cnt, (jnp.clip(c_dslot[r], 0, V - 1) * D,),
+                (D,)).astype(jnp.int32)
             # shift the row to the sublist's slot range [base,
             # base+quota): out[p] = row[p - base]. Done as a contiguous
             # dynamic_slice on a zero-padded [2P, D] image — a traced-
@@ -2093,8 +2149,9 @@ def _full_cube(d_payload, d_pdoc, d_pocc, d_cube, d_dense_rsp,
         idx = p_start[:, None] + lane[None, :]                # [Rp, Lp]
         m = lane[None, :] < p_len[:, None]
         idxc = jnp.clip(idx, 0, N - 1)
-        doc = d_pdoc[idxc]
-        occ = d_pocc[idxc].astype(jnp.int32)
+        docc = d_docc[idxc]
+        doc = (docc >> jnp.uint32(_OCC_BITS)).astype(jnp.int32)
+        occ = (docc & jnp.uint32(_OCC_MASK)).astype(jnp.int32)
         pay = (d_payload[idxc]
                | (p_syn[:, None].astype(jnp.uint32) << jnp.uint32(31)))
         dead_l = d_dead[jnp.clip(doc, 0, D - 1)]
@@ -2149,7 +2206,7 @@ def _full_cube(d_payload, d_pdoc, d_pocc, d_cube, d_dense_rsp,
 @partial(jax.jit, static_argnames=("n_positions", "lpost", "k2",
                                    "n_sel", "use_table", "use_filter",
                                    "use_sort"))
-def _direct_cube(d_cube, d_payload, d_pdoc, d_pocc, d_siterank,
+def _direct_cube(d_cube, d_payload, d_docc, d_siterank,
                  d_doclang, d_dead, n_docs_total, d_filter, d_sort,
                  g_quarter, g_qsyn,
                  p_start, p_len, p_group, p_base, p_quota, p_syn,
@@ -2206,8 +2263,9 @@ def _direct_cube(d_cube, d_payload, d_pdoc, d_pocc, d_siterank,
         idx = p_start[:, None] + lane[None, :]                # [Rp, Lp]
         m = lane[None, :] < p_len[:, None]
         idxc = jnp.clip(idx, 0, N - 1)
-        doc = d_pdoc[idxc]
-        occ = d_pocc[idxc].astype(jnp.int32)
+        docc = d_docc[idxc]
+        doc = (docc >> jnp.uint32(_OCC_BITS)).astype(jnp.int32)
+        occ = (docc & jnp.uint32(_OCC_MASK)).astype(jnp.int32)
         pay = (d_payload[idxc]
                | (p_syn[:, None].astype(jnp.uint32) << jnp.uint32(31)))
         dead_l = d_dead[jnp.clip(doc, 0, D - 1)]
