@@ -90,6 +90,12 @@ def doc_section_hashes(tdoc: TokenizedDoc) -> dict[int, int]:
     hashes): the repeatable-across-pages identity of each second-level
     container's word content."""
     from ..index.sectiondb import MIN_SECTION_WORDS
+    nat = getattr(tdoc, "native", None)
+    if nat is not None:
+        return {int(p): ghash.hash64(c) & 0xFFFFFFFF
+                for p, wc, c in zip(nat.sect_hash, nat.sect_words,
+                                    nat.sect_content)
+                if wc >= MIN_SECTION_WORDS}
     by_sid: dict[int, list[str]] = {}
     for sid, w in zip(tdoc.section_ids, tdoc.words):
         if sid:
@@ -254,11 +260,9 @@ def build_meta_list(
         sect_of = doc_section_hashes(tdoc)
     boiler = set(boiler_sections or [])
 
+    nat = getattr(tdoc, "native", None)
     doc_words = list(tdoc.words)
     words = list(doc_words)
-    wp_list = list(tdoc.wordpos)
-    hg_list = list(tdoc.hashgroups)
-    sent_list = list(tdoc.sentence_ids)
 
     if langid is None:
         langid = detect_language(doc_words, text=tdoc.text)
@@ -267,56 +271,106 @@ def build_meta_list(
     # position neighborhood (gaps > NONBODY_DIST_CAP=50 so words of
     # different anchors never look adjacent to pair scoring)
     inlinks = [(t, int(sr)) for t, sr in (inlinks or []) if t]
+    il_words: list[str] = []
+    il_wp: list[int] = []
+    il_sent: list[int] = []
     il_spam: list[int] = []
+    il_den: list[int] = []
     if inlinks:
-        pos0 = (max(wp_list) if wp_list else 0) + 100
-        sent0 = (max(sent_list) if sent_list else 0) + 1
+        pos0 = (max(tdoc.wordpos) if tdoc.wordpos else 0) + 100
+        sent0 = (max(tdoc.sentence_ids) if tdoc.sentence_ids else 0) + 1
         for j, (text, linker_sr) in enumerate(inlinks):
             aw = [w.lower() for w in _WORD_RE.findall(text)][:64]
+            dr = int(np.clip(posdb.MAXDENSITYRANK - (len(aw) - 1), 1,
+                             posdb.MAXDENSITYRANK))
             for i, w in enumerate(aw):
-                words.append(w)
-                wp_list.append(min(pos0 + i, posdb.MAXWORDPOS))
-                hg_list.append(posdb.HASHGROUP_INLINKTEXT)
-                sent_list.append(sent0 + j)
+                il_words.append(w)
+                il_wp.append(min(pos0 + i, posdb.MAXWORDPOS))
+                il_sent.append(sent0 + j)
                 il_spam.append(min(max(linker_sr, 0),
                                    posdb.MAXWORDSPAMRANK))
+                il_den.append(dr)
             pos0 += len(aw) + 100
+        words += il_words
 
-    wordpos = np.array(wp_list, dtype=np.uint64)
-    hashgroups = np.array(hg_list, dtype=np.uint64)
-    sentences = np.array(sent_list, dtype=np.uint64)
+    def _cat(a, b, dtype):
+        ba = np.array(b, dtype=dtype)
+        return np.concatenate([np.asarray(a, dtype=dtype), ba]) \
+            if len(b) else np.asarray(a, dtype=dtype)
+
+    if nat is not None:
+        wordpos = _cat(nat.wordpos, il_wp, np.uint64)
+        hashgroups = _cat(
+            nat.hashgroup,
+            [posdb.HASHGROUP_INLINKTEXT] * len(il_words), np.uint64)
+        sentences = _cat(nat.sentence, il_sent, np.uint64)
+    else:
+        wordpos = _cat(tdoc.wordpos, il_wp, np.uint64)
+        hashgroups = _cat(
+            tdoc.hashgroups,
+            [posdb.HASHGROUP_INLINKTEXT] * len(il_words), np.uint64)
+        sentences = _cat(tdoc.sentence_ids, il_sent, np.uint64)
 
     delbit = 0 if delete else 1
 
     if len(words):
-        termids = np.array([ghash.term_id(w) for w in words], dtype=np.uint64)
-        density = _density_ranks(hashgroups, sentences)
-        doc_spam = _spam_ranks(doc_words)
+        if nat is not None:
+            # native fast path: termids/density/spam precomputed in C++
+            # for the doc+url tokens; the inlink block (Python-side
+            # extras) computes per-anchor ranks by the same formulas
+            termids = _cat(
+                nat.termid,
+                [ghash.term_id(w) for w in il_words], np.uint64)
+            density = _cat(nat.density, il_den, np.uint64)
+            doc_spam = nat.spam.astype(np.uint64)
+        else:
+            termids = np.array([ghash.term_id(w) for w in words],
+                               dtype=np.uint64)
+            density = _density_ranks(hashgroups, sentences)
+            doc_spam = _spam_ranks(doc_words)
         if boiler:
             # boilerplate-section demotion (the Sections dup-vote →
             # score-weight flow): tokens of a section repeated across
             # the site get their spam rank docked
             from ..index.sectiondb import BOILER_SPAMRANK
-            bmask = np.array(
-                [sect_of.get(sid) in boiler
-                 for sid in tdoc.section_ids], dtype=bool)
+            if nat is not None:
+                bpaths = np.array(
+                    [p for p, ch in sect_of.items() if ch in boiler],
+                    dtype=np.uint64)
+                bmask = np.isin(nat.sect, bpaths)
+            else:
+                bmask = np.array(
+                    [sect_of.get(sid) in boiler
+                     for sid in tdoc.section_ids], dtype=bool)
             doc_spam = np.where(bmask,
                                 np.minimum(doc_spam, BOILER_SPAMRANK),
                                 doc_spam)
-        spam = np.concatenate([
-            doc_spam,
-            np.array(il_spam, dtype=np.uint64)]) if il_spam \
-            else doc_spam
-        keys = [posdb.pack(
-            termid=termids, docid=docid, wordpos=wordpos,
-            densityrank=density, wordspamrank=spam, siterank=siterank,
-            hashgroup=hashgroups, langid=langid, delbit=delbit,
-        )]
+        spam = _cat(doc_spam, il_spam, np.uint64)
         # bigrams: consecutive words within a sentence and hashgroup get a
         # combined term at the first word's position (reference Phrases.cpp;
         # bigram keys share the leading word's position — Posdb.cpp comment
         # "the wordpositions are exactly the same")
-        if len(words) > 1:
+        bi = np.empty(0, np.int64)
+        bids = np.empty(0, np.uint64)
+        if nat is not None:
+            # doc-token bigrams come precomputed; inlink bigrams (pairs
+            # within one anchor) are appended with the same rule
+            bi_parts = [nat.b_src.astype(np.int64)] \
+                if len(nat.b_src) else []
+            bid_parts = [nat.b_termid] if len(nat.b_termid) else []
+            if len(il_words) > 1:
+                n0 = len(nat.termid)
+                ils = np.array(il_sent)
+                pair = np.nonzero(ils[1:] == ils[:-1])[0]
+                if len(pair):
+                    bi_parts.append(pair + n0)
+                    bid_parts.append(np.array(
+                        [ghash.bigram_id(il_words[i], il_words[i + 1])
+                         for i in pair], dtype=np.uint64))
+            if bid_parts:
+                bi = np.concatenate(bi_parts)
+                bids = np.concatenate(bid_parts)
+        elif len(words) > 1:
             same_sent = sentences[1:] == sentences[:-1]
             same_hg = hashgroups[1:] == hashgroups[:-1]
             # no phrases from positionless groups (url words, meta tags) —
@@ -328,29 +382,45 @@ def build_meta_list(
                 bids = np.array(
                     [ghash.bigram_id(words[i], words[i + 1]) for i in bi],
                     dtype=np.uint64)
-                keys.append(posdb.pack(
-                    termid=bids, docid=docid, wordpos=wordpos[bi],
-                    densityrank=density[bi], wordspamrank=spam[bi],
-                    siterank=siterank, hashgroup=hashgroups[bi],
-                    langid=langid, delbit=delbit,
-                ))
-        posdb_keys = np.concatenate(keys)
+        # ONE pack per document: word keys + bigram keys + the site: and
+        # checksum extra terms (reference hashUrl/checksum terms) — the
+        # per-call broadcast overhead of separate packs measured as a
+        # top indexing cost
+        site_tid = ghash.term_id(site, prefix=SITE_PREFIX)
+        content_hash = ghash.hash64(tdoc.text or content)
+        chk_tid = ghash.term_id(f"{content_hash:x}",
+                                prefix=CONTENT_HASH_PREFIX)
+        two0 = np.zeros(2, np.uint64)
+        n_all = len(termids) + len(bids) + 2
+        sbt = np.zeros(n_all, np.uint64)
+        sbt[-1] = 1  # checksum term shards by termid
+        posdb_keys = posdb.pack(
+            termid=np.concatenate(
+                [termids, bids,
+                 np.array([site_tid, chk_tid], np.uint64)]),
+            docid=docid,
+            wordpos=np.concatenate([wordpos, wordpos[bi], two0]),
+            densityrank=np.concatenate([density, density[bi], two0]),
+            wordspamrank=np.concatenate(
+                [spam, spam[bi],
+                 np.full(2, posdb.MAXWORDSPAMRANK, np.uint64)]),
+            siterank=siterank,
+            hashgroup=np.concatenate(
+                [hashgroups, hashgroups[bi],
+                 np.full(2, posdb.HASHGROUP_INURL, np.uint64)]),
+            langid=langid, delbit=delbit, shardbytermid=sbt,
+        )
     else:
-        posdb_keys = np.empty(0, dtype=posdb.KEY_DTYPE)
-
-    # site: term for fielded search (reference hashUrl/hashIncomingLinkText
-    # emit site:/inurl: prefixed terms)
-    site_tid = ghash.term_id(site, prefix=SITE_PREFIX)
-    content_hash = ghash.hash64(tdoc.text or content)
-    extra_terms = posdb.pack(
-        termid=[site_tid,
-                ghash.term_id(f"{content_hash:x}", prefix=CONTENT_HASH_PREFIX)],
-        docid=docid, wordpos=0, siterank=siterank, langid=langid,
-        hashgroup=posdb.HASHGROUP_INURL, delbit=delbit,
-        shardbytermid=[0, 1],
-    )
-    posdb_keys = np.concatenate([posdb_keys, extra_terms]) if len(posdb_keys) \
-        else extra_terms
+        site_tid = ghash.term_id(site, prefix=SITE_PREFIX)
+        content_hash = ghash.hash64(tdoc.text or content)
+        posdb_keys = posdb.pack(
+            termid=[site_tid,
+                    ghash.term_id(f"{content_hash:x}",
+                                  prefix=CONTENT_HASH_PREFIX)],
+            docid=docid, wordpos=0, siterank=siterank, langid=langid,
+            hashgroup=posdb.HASHGROUP_INURL, delbit=delbit,
+            shardbytermid=[0, 1],
+        )
 
     # structured fields: resolve the built-in date ONCE and store the
     # resolved dict in the titlerec, so the tombstone path regenerates

@@ -267,10 +267,44 @@ class _HtmlTok(HTMLParser):
         self._sent -= 1
 
 
+def _native_tdoc(content: str, url: str | None,
+                 is_html: bool) -> TokenizedDoc | None:
+    """Native (C++) tokenize+hash+rank path — the host build plane the
+    reference keeps in C++ (XmlDoc::hashAll, Words.cpp/Pos.cpp). Fills
+    the TokenizedDoc compat lists AND attaches the columnar product as
+    ``.native`` so docproc skips per-word Python hashing entirely.
+    None = lib unavailable or disabled (OSSE_NATIVE_TOKENIZE=0)."""
+    import os
+    if os.environ.get("OSSE_NATIVE_TOKENIZE", "1") == "0":
+        return None
+    from .. import native
+    try:
+        cols = native.tokenize_native(content, url, is_html)
+    except Exception:  # noqa: BLE001 — any native fault → Python path
+        return None
+    if cols is None:
+        return None
+    doc = TokenizedDoc(
+        words=list(cols.words),
+        wordpos=cols.wordpos.tolist(),
+        hashgroups=cols.hashgroup.tolist(),
+        sentence_ids=cols.sentence.tolist(),
+        section_ids=cols.sect.tolist(),
+        title=cols.title, meta_description=cols.desc,
+        meta_date=cols.date, links=list(cols.links), text=cols.text)
+    doc.native = cols
+    return doc
+
+
 def tokenize_html(html: str, url: str | None = None) -> TokenizedDoc:
     """Tokenize an HTML document; URL path words are added to
     HASHGROUP_INURL (reference hashes the url into its own group,
-    ``XmlDoc.cpp`` ``hashUrl``)."""
+    ``XmlDoc.cpp`` ``hashUrl``). Dispatches to the native C++ core when
+    available (bit-identical for ASCII documents; the Python
+    HTMLParser path remains the fallback and the reference semantics)."""
+    doc = _native_tdoc(html, url, True)
+    if doc is not None:
+        return doc
     p = _HtmlTok()
     p.feed(html)
     p.close()
@@ -289,6 +323,10 @@ def tokenize_html(html: str, url: str | None = None) -> TokenizedDoc:
 def tokenize_text(text: str, hashgroup: int = HASHGROUP_BODY) -> TokenizedDoc:
     """Tokenize plain text (injection of non-HTML content; reference doc
     converters produce plain text fed through the same path)."""
+    if hashgroup == HASHGROUP_BODY:
+        doc = _native_tdoc(text, None, False)
+        if doc is not None:
+            return doc
     p = _HtmlTok()
     p._emit_words(text, hashgroup)
     doc = p.doc

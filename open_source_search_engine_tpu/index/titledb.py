@@ -69,7 +69,10 @@ def make_title_rec(url: str, title: str, text: str, links: list,
     }
     if extra:
         rec.update(extra)
-    return zlib.compress(json.dumps(rec).encode("utf-8"), level=6)
+    # level 1: ~3× faster than 6 for ~10% larger recs — indexing is
+    # compute-bound (the reference's niceness-2 build competes for the
+    # same cores that serve queries)
+    return zlib.compress(json.dumps(rec).encode("utf-8"), level=1)
 
 
 def read_title_rec(blob: bytes) -> dict:

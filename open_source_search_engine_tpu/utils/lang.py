@@ -117,17 +117,17 @@ _SCRIPTS: list[tuple[int, int, int]] = [
 def detect_script(text: str, sample: int = 4000) -> int:
     """Dominant non-Latin script over a character sample → langId
     (LANG_UNKNOWN when the text is overwhelmingly Latin/other)."""
+    t = text[:sample]
+    if t.isascii():  # C-speed common case: nothing above 0x7F
+        return LANG_UNKNOWN
+    import numpy as np
+    cps = np.frombuffer(t.encode("utf-32-le"), dtype=np.uint32)
+    cps = cps[cps >= 0x0370]
     counts: dict[int, int] = {}
-    total = 0
-    for ch in text[:sample]:
-        cp = ord(ch)
-        if cp < 0x0370:  # latin / punctuation / digits
-            continue
-        for lo, hi, lang in _SCRIPTS:
-            if lo <= cp <= hi:
-                counts[lang] = counts.get(lang, 0) + 1
-                total += 1
-                break
+    for lo, hi, lang in _SCRIPTS:
+        c = int(((cps >= lo) & (cps <= hi)).sum())
+        if c:
+            counts[lang] = counts.get(lang, 0) + c
     if not counts:
         return LANG_UNKNOWN
     best = max(counts, key=counts.get)

@@ -1,0 +1,177 @@
+"""Native (C++) build-plane parity: libdoccore vs the Python tokenizer.
+
+The native path must be bit-identical to the Python reference path for
+ASCII documents — same token columns, same term ids, same packed posdb
+keys — so a collection indexed by either path (or a cluster mixing
+both) produces identical postings. Reference seam: XmlDoc::hashAll
+(XmlDoc.cpp:28957) and the Words.cpp/Pos.cpp tokenizer, whose host
+plane is likewise C++.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from open_source_search_engine_tpu import native
+from open_source_search_engine_tpu.build import docproc
+from open_source_search_engine_tpu.build import tokenizer as T
+from open_source_search_engine_tpu.utils import ghash
+
+pytestmark = pytest.mark.skipif(
+    native.get_doccore() is None, reason="native doccore unavailable")
+
+GNARLY = """<html><head><title>Tiger &amp; Friends: a Story</title>
+<meta name="description" content="All about tigers; and lions.">
+<meta property="article:published_time" content="2021-03-04T10:00:00">
+<meta name="keywords" content="tiger lion habitat">
+</head><body>
+<nav><ul><li><a href="/home">Home page</a></li>
+<li><a href="/about?x=1&amp;y=2">About us</a></li></ul></nav>
+<h1>Tiger Habitat</h1>
+<div class="main"><p>Tigers live in forests. They hunt deer, boar; and fish!
+Are tigers endangered? Yes: very much so...</p>
+<p>Second paragraph with <b>bold text</b> and
+<a href="http://x.test/z">an external link</a>.</p></div>
+<script>var x = "<p>ignored</p>";</script>
+<style>.c { color: red; }</style>
+<!-- a comment with <p>tags</p> inside -->
+<table><tr><td>cell one</td><td>cell two</td></tr></table>
+<footer><p>Copyright 2021 Tiger Site. All rights reserved.</p></footer>
+<br/>trailing text
+</body></html>"""
+
+URL = "http://example.com/tigers-page_1"
+
+
+def _both(html, url):
+    os.environ["OSSE_NATIVE_TOKENIZE"] = "0"
+    try:
+        py = T.tokenize_html(html, url)
+    finally:
+        os.environ["OSSE_NATIVE_TOKENIZE"] = "1"
+    nat = T.tokenize_html(html, url)
+    assert getattr(nat, "native", None) is not None
+    return py, nat
+
+
+class TestTokenizerParity:
+    def test_columns_identical(self):
+        py, nat = _both(GNARLY, URL)
+        assert py.words == nat.words
+        assert py.wordpos == nat.wordpos
+        assert py.hashgroups == nat.hashgroups
+        assert py.sentence_ids == nat.sentence_ids
+        assert py.section_ids == nat.section_ids
+
+    def test_strings_identical(self):
+        py, nat = _both(GNARLY, URL)
+        assert py.title == nat.title
+        assert py.meta_description == nat.meta_description
+        assert py.meta_date == nat.meta_date
+        assert py.text == nat.text
+        assert py.links == nat.links
+
+    def test_termids_match_ghash(self):
+        _, nat = _both(GNARLY, URL)
+        tids = np.array([ghash.term_id(w) for w in nat.words], np.uint64)
+        assert (tids == nat.native.termid).all()
+
+    def test_punctuation_edges(self):
+        for frag in ("a.b", "...x", "x...", "a.!?b", "", ".",
+                     "one two. three"):
+            py, nat = _both(f"<p>{frag}</p>", None)
+            assert py.words == nat.words, frag
+            assert py.wordpos == nat.wordpos, frag
+            assert py.sentence_ids == nat.sentence_ids, frag
+
+    def test_edge_cases_parity(self):
+        # stray '<' as data, entities, NUL bytes, unicode whitespace,
+        # no-semicolon charrefs; unknowns must FALL BACK, not diverge
+        cases = ["<p>1 < 2 > 3 and a<b</p>",
+                 "<p>caf&eacute; and 5&times;3</p>",
+                 "<p>a&nbsp;b</p>",
+                 "<p>hello \x00 world this is text</p>",
+                 "<p>x&#65 y</p>",
+                 "<p>AT&T and &ampx</p>",          # legacy prefix → punt
+                 "<p>x &hellip; y &frobnicate; z</p>"]  # unknown → punt
+        for html in cases:
+            os.environ["OSSE_NATIVE_TOKENIZE"] = "0"
+            try:
+                py = T.tokenize_html(html, None)
+            finally:
+                os.environ["OSSE_NATIVE_TOKENIZE"] = "1"
+            nat = T.tokenize_html(html, None)  # may legally punt
+            assert py.words == nat.words, html
+            assert py.wordpos == nat.wordpos, html
+            assert py.text == nat.text, html
+            assert py.sentence_ids == nat.sentence_ids, html
+
+    def test_plain_text_parity(self):
+        os.environ["OSSE_NATIVE_TOKENIZE"] = "0"
+        try:
+            py = T.tokenize_text("Plain text. With sentences! And words")
+        finally:
+            os.environ["OSSE_NATIVE_TOKENIZE"] = "1"
+        nat = T.tokenize_text("Plain text. With sentences! And words")
+        assert py.words == nat.words
+        assert py.wordpos == nat.wordpos
+
+
+class TestHashParity:
+    def test_hash64(self):
+        lib = native.get_doccore()
+        for s in (b"tiger", b"a", b"", b"word123", b"x" * 1024):
+            expect = ghash._FNV_OFFSET
+            # recompute via the pure-python loop (bypass the native
+            # dispatch inside ghash.hash64)
+            h = ghash._FNV_OFFSET
+            for b in s:
+                h ^= b
+                h = (h * ghash._FNV_PRIME) & ghash._MASK64
+            h ^= h >> 33
+            h = (h * 0xFF51AFD7ED558CCD) & ghash._MASK64
+            h ^= h >> 33
+            h = (h * 0xC4CEB9FE1A85EC53) & ghash._MASK64
+            h ^= h >> 33
+            assert native.hash64_native(s) == h
+
+
+class TestMetaListParity:
+    def test_posdb_keys_identical(self):
+        inl = [("big tiger story", 5), ("tiger", 3)]
+        os.environ["OSSE_NATIVE_TOKENIZE"] = "0"
+        try:
+            a = docproc.build_meta_list(URL, GNARLY, siterank=3,
+                                        inlinks=inl)
+        finally:
+            os.environ["OSSE_NATIVE_TOKENIZE"] = "1"
+        b = docproc.build_meta_list(URL, GNARLY, siterank=3, inlinks=inl)
+        ka = np.sort(a.posdb_keys, order=("n2", "n1", "n0"))
+        kb = np.sort(b.posdb_keys, order=("n2", "n1", "n0"))
+        assert len(ka) == len(kb)
+        assert (ka == kb).all()
+        assert a.sections == b.sections
+        assert a.langid == b.langid
+        assert a.docid == b.docid
+
+    def test_boiler_demotion_parity(self):
+        # same section across "pages" — demote via explicit boiler set
+        sect_py = None
+        os.environ["OSSE_NATIVE_TOKENIZE"] = "0"
+        try:
+            t = T.tokenize_html(GNARLY, URL)
+            sect_py = docproc.doc_section_hashes(t)
+            boiler = list(sect_py.values())[:1]
+            a = docproc.build_meta_list(URL, GNARLY, siterank=0,
+                                        boiler_sections=boiler)
+        finally:
+            os.environ["OSSE_NATIVE_TOKENIZE"] = "1"
+        t2 = T.tokenize_html(GNARLY, URL)
+        sect_nat = docproc.doc_section_hashes(t2)
+        assert sect_py == sect_nat
+        b = docproc.build_meta_list(URL, GNARLY, siterank=0,
+                                    boiler_sections=boiler)
+        ka = np.sort(a.posdb_keys, order=("n2", "n1", "n0"))
+        kb = np.sort(b.posdb_keys, order=("n2", "n1", "n0"))
+        assert (ka == kb).all()
