@@ -1,0 +1,85 @@
+"""Shared kernel-route exercise corpus + parity comparators.
+
+Used by BOTH the driver's multichip dryrun (``__graft_entry__``) and
+``tests/test_mesh_routes.py`` so the corpus shape, the route
+expectations and the tie-run parity semantics cannot drift apart.
+
+The corpus is shaped so that, with the dense/cube thresholds scaled
+down (``OSSE_DENSE_MIN_DF=8`` / ``OSSE_CUBE_MIN_DF=4``), specific
+queries deterministically take each kernel route:
+
+* ``zeta`` (rare) → two-phase F1 with a bounded driver;
+* ``alpha`` (everywhere, single term) → F1 whose κ ladder escalates
+  (matches cluster in the low selection blocks);
+* ``alpha beta`` (everywhere, multi term) → direct-cube FD;
+* ``boxes dogs`` → the conjugates box/boxe + the present bigram give
+  the group 3 variants, quota 2 each — NOT quarter-aligned — so the
+  cube run disqualifies the direct kernel → generic assembling F2.
+"""
+
+from __future__ import annotations
+
+ROUTE_QUERIES = {
+    "zeta": "f1",
+    "alpha": "f1",
+    "alpha beta": "fd",
+    "boxes dogs": "f2",
+}
+
+#: env values that scale dense/cube row thresholds to tiny shards
+ROUTE_ENV = {"OSSE_DENSE_MIN_DF": "8", "OSSE_CUBE_MIN_DF": "4"}
+
+
+def route_docs(n: int, host_prefix: str = "mesh"):
+    """The n-doc route-exercise corpus (distinct registrable domains —
+    a single domain would both collapse under Msg51 site clustering
+    and take the PQR per-domain geometric demotion, which stamps
+    rank-dependent scores and breaks tie comparison)."""
+    out = []
+    for i in range(n):
+        extra = ["boxes dogs box boxe"]
+        if i % 2 == 0:
+            extra.append("gamma")
+        if i % 13 == 0:
+            extra.append("zeta")
+        body = f"alpha beta {' '.join(extra)} token{i} words here."
+        out.append((f"http://{host_prefix}{i % 23}.test/doc{i}",
+                    f"<html><head><title>Doc {i} alpha</title></head>"
+                    f"<body><p>{body}</p></body></html>"))
+    return out
+
+
+def assert_tie_run_parity(r_a, r_b, label: str = "") -> None:
+    """Exact score-sequence equality + docid SET equality per complete
+    equal-score run. Tie order inside a run is legitimately
+    selection-dependent (different kernels pick different members of a
+    tie first), and a run cut by the k boundary may hold a different
+    tie subset — only complete runs compare."""
+    assert r_a.total_matches == r_b.total_matches, (
+        f"{label}: total_matches {r_a.total_matches} != "
+        f"{r_b.total_matches}")
+    sa = [x.score for x in r_a.results]
+    sb = [y.score for y in r_b.results]
+    assert sa == sb, f"{label}: score lists disagree"
+    ids_a = [x.docid for x in r_a.results]
+    ids_b = [y.docid for y in r_b.results]
+    i, n = 0, len(sa)
+    while i < n:
+        j = i
+        while j < n and sa[j] == sa[i]:
+            j += 1
+        if j < n or r_a.total_matches <= n:
+            assert set(ids_a[i:j]) == set(ids_b[i:j]), (
+                f"{label}: tie run [{i},{j}) disagrees")
+        i = j
+
+
+def route_hits(indexes, fn):
+    """Run ``fn()`` and return the per-route query-count delta summed
+    over ``indexes``."""
+    before = {k: sum(di.route_counts[k] for di in indexes)
+              for k in ("f1", "fd", "f2")}
+    out = fn()
+    hits = {k: sum(di.route_counts[k] for di in indexes) - before[k]
+            for k in before}
+    return out, hits

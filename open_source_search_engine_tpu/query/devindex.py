@@ -283,6 +283,18 @@ def _pad_col(a: np.ndarray, size: int) -> np.ndarray:
     return out
 
 
+def _env_int(name: str, default: int) -> int:
+    """Env-overridable tuning constant — tests and the multichip dryrun
+    scale the dense/cube thresholds down so TINY per-shard corpora still
+    build dense+cube rows and exercise every kernel route (production
+    defaults are sized for real shards)."""
+    import os
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
 @partial(jax.jit, donate_argnums=0)
 def _write_tail(buf, tail, offset):
     """Donated in-place rewrite of the delta tail of a device column."""
@@ -461,6 +473,10 @@ class DeviceIndex:
         self.full_rebuilds = 0    # O(corpus) base rebuilds (run-set moved)
         self.delta_rebuilds = 0   # O(memtable) delta-only refreshes
         self.escalations = 0      # phase-2 κ escalations (pruning misses)
+        #: kernel-route observability: queries initially routed to the
+        #: two-phase (f1), direct-cube (fd) and generic full-cube (f2)
+        #: kernels (escalation reruns not counted)
+        self.route_counts = {"f1": 0, "fd": 0, "f2": 0}
         self.refresh()
 
     def _put(self, a):
@@ -648,7 +664,8 @@ class DeviceIndex:
         # columns (uploading [V, D] host arrays would ship ~GBs through
         # the host link; the descriptors below are a few KB) ---
         dfs = np.diff(self.dir_dstart)
-        tau = max(DENSE_MIN_DF, self.D_cap // 64)
+        tau = max(_env_int("OSSE_DENSE_MIN_DF", DENSE_MIN_DF),
+                  self.D_cap // 64)
         # 9 bytes per (term, doc) slot: f32 impact + int32 rs + u8 cnt
         slots_budget = max(DENSE_BUDGET_BYTES // (9 * self.D_cap), 1)
         eligible = np.nonzero(dfs > tau)[0]
@@ -1287,7 +1304,7 @@ class DeviceIndex:
         # full-cube scoring is cheaper than the escalation ladder. With
         # dense impact rows covering mid-df terms, F1 stays cheap up to
         # κ=8192, so only genuinely corpus-wide drivers route to F2
-        f2_cut = min(4 * CUBE_MIN_DF,
+        f2_cut = min(4 * _env_int("OSSE_CUBE_MIN_DF", CUBE_MIN_DF),
                      max(2 * KAPPA_FLOOR, self.n_docs // 8))
 
         def _route_f2(i):
@@ -1317,6 +1334,11 @@ class DeviceIndex:
 
         f2 = [i for i in live if _route_f2(i)]
         f1 = [i for i in live if i not in set(f2)]
+        self.route_counts["f1"] += len(f1)
+        self.route_counts["fd"] += sum(
+            1 for i in f2 if plans[i].direct_ok)
+        self.route_counts["f2"] += sum(
+            1 for i in f2 if not plans[i].direct_ok)
 
         # wave loop: issue EVERY sub-batch dispatch, fetch ALL outputs
         # in one device_get (one tunnel RTT), then parse; queries whose

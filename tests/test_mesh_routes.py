@@ -1,0 +1,91 @@
+"""Cross-shard golden results for EVERY kernel route on a virtual mesh.
+
+An 8-device CPU mesh serves a corpus shaped so each shard builds real
+base columns + dense rows + cube rows, and specific queries
+deterministically take each kernel route: two-phase F1 (bounded driver
+and an escalating single-term), direct-cube FD (common multi-term), and
+the generic assembling F2 (conjugate-rich group whose slot plan is not
+quarter-aligned). Golden contract: the MeshResident path, the shard_map
+path, and the FLAT single-collection host path agree on match counts
+and scores (reference seam: Msg39 per-shard intersect + Msg3a merge,
+Msg39.cpp:74 / Msg3a.cpp:971). Corpus + comparators live in
+``parallel.routecheck``, shared with the driver's multichip dryrun.
+"""
+
+import os
+import tempfile
+
+import pytest
+
+from open_source_search_engine_tpu.build import docproc
+from open_source_search_engine_tpu.index.collection import Collection
+from open_source_search_engine_tpu.parallel import make_mesh, sharded_search
+from open_source_search_engine_tpu.parallel.routecheck import (
+    ROUTE_ENV, ROUTE_QUERIES, assert_tie_run_parity, route_docs,
+    route_hits)
+from open_source_search_engine_tpu.parallel.sharded import (
+    MeshResident, ShardedCollection)
+from open_source_search_engine_tpu.query import engine
+
+N_SHARDS = 8
+
+
+@pytest.fixture(scope="module")
+def mesh_env():
+    saved = {k: os.environ.get(k) for k in ROUTE_ENV}
+    os.environ.update(ROUTE_ENV)
+    try:
+        docs = route_docs(48 * N_SHARDS)
+        sdir = tempfile.mkdtemp(prefix="mesh_routes_s_")
+        sc = ShardedCollection("mesh", sdir, n_shards=N_SHARDS)
+        for url, html in docs:
+            sc.index_document(url, html)
+        for sh in sc.shards:
+            sh.posdb.dump()
+            sh.titledb.dump()
+            sh.save()
+        fdir = tempfile.mkdtemp(prefix="mesh_routes_f_")
+        flat = Collection("mesh", fdir)
+        docproc.index_batch(flat, docs)
+        flat.posdb.dump()
+        flat.titledb.dump()
+        yield sc, MeshResident(sc), flat
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+class TestMeshRoutes:
+    def test_every_shard_has_real_base(self, mesh_env):
+        _, mr, _ = mesh_env
+        for s, di in enumerate(mr.indexes):
+            assert di.n_docs > 0, s
+            assert len(di.dense_slot_of) > 0, s
+            assert len(di.cube_slot_of) > 0, s
+
+    @pytest.mark.parametrize("q,route", list(ROUTE_QUERIES.items()))
+    def test_route_and_golden(self, mesh_env, q, route):
+        sc, mr, flat = mesh_env
+        _, hits = route_hits(mr.indexes, lambda: mr.search(q, topk=8))
+        assert hits[route] == N_SHARDS, (q, hits)
+
+        # goldens run with site clustering OFF so equal-score ties sit
+        # adjacently (see routecheck.assert_tie_run_parity)
+        r_mesh = mr.search(q, topk=8, site_cluster=False)
+        r_map = sharded_search(sc, q, mesh=make_mesh(N_SHARDS), topk=8,
+                               site_cluster=False)
+        r_flat = engine.search(flat, q, topk=8, site_cluster=False)
+        assert_tie_run_parity(r_mesh, r_map, label=q)
+        assert r_mesh.total_matches == r_flat.total_matches, q
+        sa = [round(x.score, 2) for x in r_mesh.results]
+        sf = [round(z.score, 2) for z in r_flat.results]
+        assert sa == sf, q
+
+    def test_escalation_exercised(self, mesh_env):
+        _, mr, _ = mesh_env
+        esc0 = sum(di.escalations for di in mr.indexes)
+        mr.search("alpha", topk=8)
+        assert sum(di.escalations for di in mr.indexes) > esc0
