@@ -498,7 +498,7 @@ class Rdb:
     def __init__(self, name: str, directory: str | Path,
                  key_dtype: np.dtype, has_data: bool = False,
                  max_memtable_bytes: int = 64 << 20,
-                 max_runs: int = 8):
+                 max_runs: int = 8, journal: bool = True):
         self.name = name
         self.dir = Path(directory) / name
         self.dir.mkdir(parents=True, exist_ok=True)
@@ -515,13 +515,29 @@ class Rdb:
         #: bumped on every mutation; device-resident mirrors compare it
         #: to know when to repack (the Rdb dump/merge → repack cycle)
         self.version = 0
+        #: write-ahead journal (Msg4 addsinprogress semantics,
+        #: ``Msg4.cpp:86,115``): every buffered add/delete appends here
+        #: BEFORE touching the memtable and replays on open, so a
+        #: kill -9 between dumps loses no acknowledged record. The
+        #: journal truncates whenever the memtable persists (dump/save).
+        #: Callers with their own journaling (spiderdb) pass False;
+        #: OSSE_NO_JOURNAL=1 disables globally for bulk rebuilds where
+        #: the source data is itself durable (repair/rebalance — the
+        #: ~2× write amplification buys nothing there).
+        import os as _os
+        self.journal_enabled = journal and \
+            _os.environ.get("OSSE_NO_JOURNAL") != "1"
+        self._journal_path = self.dir / "addsinprogress.bin"
+        self._journal_f = None
         self._load_existing_runs()
 
     # --- writes ---
 
     def add(self, keys: np.ndarray, blobs: list[bytes] | None = None) -> None:
         """Add records; auto-dump when the memtable exceeds budget
-        (reference dumps at 90% full, ``Rdb.cpp:1172``)."""
+        (reference dumps at 90% full, ``Rdb.cpp:1172``). The write
+        journals BEFORE it applies (Msg4 addsinprogress)."""
+        self._journal_append(keys, blobs)
         self.mem.add(keys, blobs)
         self.version += 1
         if self.mem.nbytes >= self.max_memtable_bytes:
@@ -530,7 +546,9 @@ class Rdb:
     def delete(self, keys: np.ndarray) -> None:
         """Add tombstones for these keys (delbit cleared)."""
         neg = strip_delbit(np.atleast_1d(keys).astype(self.key_dtype, copy=False))
-        self.mem.add(neg, [b""] * len(neg) if self.has_data else None)
+        blobs = [b""] * len(neg) if self.has_data else None
+        self._journal_append(neg, blobs)
+        self.mem.add(neg, blobs)
         self.version += 1
 
     def wipe(self) -> None:
@@ -543,6 +561,7 @@ class Rdb:
         saved = self.dir / "saved"
         if saved.exists():
             shutil.rmtree(saved)
+        self._journal_truncate()
         self.version += 1
 
     def dump(self) -> Run | None:
@@ -560,6 +579,7 @@ class Rdb:
         saved = self.dir / "saved"
         if saved.exists():
             shutil.rmtree(saved)
+        self._journal_truncate()  # records now live in the run
         log.debug("%s: dumped run %s (%d recs)", self.name, run.path.name, len(run))
         if len(self.runs) > self.max_runs:
             self.attempt_merge()
@@ -666,16 +686,37 @@ class Rdb:
     # --- checkpoint (Process::saveRdbTrees equivalent) ---
 
     def save(self) -> None:
-        """Persist the memtable so a restart is lossless (``-saved.dat``)."""
+        """Persist the memtable so a restart is lossless (``-saved.dat``).
+
+        Publish-then-swap: the new checkpoint is fully written to
+        ``saved.new`` BEFORE the old one is removed, so no crash window
+        exists where neither checkpoint nor journal holds the records
+        (load_saved picks up a stranded ``saved.new``)."""
         batch = self.mem.batch()
         saved = self.dir / "saved"
-        if saved.exists():
-            shutil.rmtree(saved)
+        newp = self.dir / "saved.new"
+        if newp.exists():
+            shutil.rmtree(newp)
         if len(batch):
-            Run.write(saved, batch)
+            Run.write(newp, batch)
+            if saved.exists():
+                shutil.rmtree(saved)
+            newp.rename(saved)
+        elif saved.exists():
+            shutil.rmtree(saved)
+        self._journal_truncate()  # checkpoint covers the journal now
 
     def load_saved(self) -> None:
         saved = self.dir / "saved"
+        newp = self.dir / "saved.new"
+        if not saved.exists() and newp.exists():
+            # crash between publishing saved.new and the swap: the new
+            # checkpoint is complete (Run.write is atomic) — adopt it
+            try:
+                Run(newp)
+                newp.rename(saved)
+            except CorruptRunError:
+                shutil.rmtree(newp)  # torn write: journal still covers
         if saved.exists():
             b = Run(saved).batch()
             self.mem.add(b.keys.copy(),
@@ -709,3 +750,93 @@ class Rdb:
                 log.error("%s: QUARANTINED corrupt run: %s",
                           self.name, e)
         self.load_saved()
+        if self.journal_enabled:
+            self._replay_journal()
+
+    # --- write-ahead journal (Msg4 addsinprogress) ---------------------
+
+    def _journal_append(self, keys: np.ndarray,
+                        blobs: list[bytes] | None) -> None:
+        """One fsync-free append per add batch: header + key image +
+        blob table, CRC-protected so a torn tail is detected at replay.
+        flush() alone survives kill -9 (the OS page cache outlives the
+        process); set OSSE_JOURNAL_FSYNC=1 for power-failure durability
+        at ~1 ms/batch."""
+        if not self.journal_enabled:
+            return
+        import os as _os
+        import struct
+        import zlib as _zlib
+        if self._journal_f is None:
+            self._journal_f = open(self._journal_path, "ab")  # noqa: SIM115
+        kb = np.ascontiguousarray(keys).tobytes()
+        if self.has_data:
+            blobs = blobs if blobs is not None else [b""] * len(keys)
+            lens = np.array([len(b) for b in blobs], np.uint32)
+            body = kb + lens.tobytes() + b"".join(blobs)
+        else:
+            body = kb
+        hdr = struct.pack("<IIQ", len(keys),
+                          _zlib.crc32(body) & 0xFFFFFFFF, len(body))
+        self._journal_f.write(hdr + body)
+        self._journal_f.flush()
+        if _os.environ.get("OSSE_JOURNAL_FSYNC") == "1":
+            _os.fsync(self._journal_f.fileno())
+
+    def _replay_journal(self) -> None:
+        """Re-apply journaled batches on open (records added after the
+        last dump/save); a torn or corrupt tail batch stops the replay
+        — exactly the records that were never acknowledged."""
+        if not self._journal_path.exists():
+            return
+        import struct
+        import zlib as _zlib
+        data = self._journal_path.read_bytes()
+        ks = self.key_dtype.itemsize
+        off, n_rec = 0, 0
+        while off + 16 <= len(data):
+            n, crc, blen = struct.unpack_from("<IIQ", data, off)
+            off += 16
+            if off + blen > len(data) or \
+                    (_zlib.crc32(data[off:off + blen]) & 0xFFFFFFFF) \
+                    != crc:
+                log.warning("%s: journal torn at byte %d — replay "
+                            "stops (unacknowledged tail)", self.name,
+                            off - 16)
+                # truncate to the valid prefix: appending after the
+                # torn batch would strand every later (CRC-valid)
+                # batch behind it at the NEXT replay
+                import os as _os
+                with open(self._journal_path, "r+b") as jf:
+                    jf.truncate(off - 16)
+                break
+            body = data[off:off + blen]
+            off += blen
+            keys = np.frombuffer(body[: n * ks],
+                                 dtype=self.key_dtype).copy()
+            blobs = None
+            if self.has_data:
+                lens = np.frombuffer(body[n * ks: n * ks + 4 * n],
+                                     np.uint32)
+                p = n * ks + 4 * n
+                blobs = []
+                for ln in lens:
+                    blobs.append(body[p: p + int(ln)])
+                    p += int(ln)
+            self.mem.add(keys, blobs)
+            n_rec += int(n)
+        if n_rec:
+            self.version += 1
+            log.info("%s: replayed %d journaled records "
+                     "(addsinprogress)", self.name, n_rec)
+            if self.mem.nbytes >= self.max_memtable_bytes:
+                self.dump()
+
+    def _journal_truncate(self) -> None:
+        if not self.journal_enabled:
+            return
+        if self._journal_f is not None:
+            self._journal_f.close()
+            self._journal_f = None
+        if self._journal_path.exists():
+            self._journal_path.unlink()

@@ -109,8 +109,10 @@ class SpiderDb:
     ``addsinprogress.dat``, ``Msg4.cpp:115``)."""
 
     def __init__(self, directory: str | Path):
+        # journal=False: spiderdb keeps its own semantic jsonl journal
+        # below — the generic Rdb WAL would double-write every record
         self.rdb = rdblite.Rdb("spiderdb", directory, KEY_DTYPE,
-                               has_data=True)
+                               has_data=True, journal=False)
         self._journal_path = self.rdb.dir / "addsinprogress.jsonl"
         self._replay_journal()
         self._journal = open(self._journal_path, "a",  # noqa: SIM115
