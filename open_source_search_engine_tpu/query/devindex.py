@@ -666,11 +666,18 @@ class DeviceIndex:
         dfs = np.diff(self.dir_dstart)
         tau = max(_env_int("OSSE_DENSE_MIN_DF", DENSE_MIN_DF),
                   self.D_cap // 64)
-        # 9 bytes per (term, doc) slot: f32 impact + int32 rs + u8 cnt
-        slots_budget = max(DENSE_BUDGET_BYTES // (9 * self.D_cap), 1)
+        # 9 bytes per (term, doc) slot: f32 impact + int32 rs + u8 cnt.
+        # The slot count V power-of-two buckets (V is a kernel shape),
+        # so the budget must hold for the BUCKETED V — at big D_cap a
+        # raw-count budget bucketed up overshot HBM and the int32
+        # scatter index space (measured at 250k docs: V 341→512)
+        v_cap = 8
+        while (2 * v_cap * 9 * self.D_cap <= DENSE_BUDGET_BYTES
+               and 2 * v_cap * self.D_cap < (1 << 31)):
+            v_cap *= 2
         eligible = np.nonzero(dfs > tau)[0]
         eligible = eligible[np.argsort(-dfs[eligible], kind="stable")]
-        dense_terms = eligible[:slots_budget]
+        dense_terms = eligible[:v_cap]
         V = _bucket(max(len(dense_terms), 1), 8)
         self.dense_slot_of: dict[int, int] = {}
         dr_starts = np.zeros(max(len(dense_terms), 1), np.int32)
@@ -697,10 +704,17 @@ class DeviceIndex:
             CUBE_BUDGET_BYTES,
             max(1 << 30, HBM_USABLE_BYTES - cols_bytes - dense_bytes
                 - WAVE_RESERVE_BYTES))
-        cube_budget = max(cube_bytes // (P * self.D_cap * 4), 1)
-        cube_terms = dense_terms[:cube_budget]
-        # +1: the last slot stays all-zero — the FD kernel's "absent
+        # Vc also buckets to a power of two AND its flat [Vc·P·D] index
+        # space must stay inside int32 for the build scatter — budget
+        # against the bucketed size (at 250k docs the raw count 161
+        # bucketed to 256 → exactly 2^31 elements → overflow)
+        vc_cap = 4
+        while (2 * vc_cap * P * self.D_cap * 4 <= cube_bytes
+               and 2 * vc_cap * P * self.D_cap < (1 << 31)):
+            vc_cap *= 2
+        # −1: the last slot stays all-zero — the FD kernel's "absent
         # quarter" target (zero payload = invalid by convention)
+        cube_terms = dense_terms[:vc_cap - 1]
         Vc = _bucket(len(cube_terms) + 1, 4)
         self.cube_zero_slot = Vc - 1
         self.cube_slot_of: dict[int, int] = {}
