@@ -55,6 +55,10 @@ from .hostmap import HostMap
 log = get_logger("cluster")
 
 RPC_TIMEOUT_S = 10.0
+#: interactive reads that can legitimately run long (deep paging, big
+#: escalations) get their own budget — a 10 s cap would reroute to the
+#: twin (doubling work) and falsely mark slow-but-alive hosts dead
+SEARCH_TIMEOUT_S = 60.0
 PING_TIMEOUT_S = 1.5
 RETRY_INTERVAL_S = 1.0
 HEARTBEAT_INTERVAL_S = 1.0
@@ -130,6 +134,11 @@ class ShardNodeServer:
         self.use_device = use_device
         self._httpd: ThreadingHTTPServer | None = None
         self._lock = threading.RLock()  # single-writer core
+        #: background RPCs (X-Niceness: 1 — spider writes, heal pulls)
+        #: yield to in-flight interactive reads at the door, BEFORE
+        #: contending for the writer lock (UdpProtocol.h niceness bit)
+        from ..utils.nice import NicenessGate
+        self.nice_gate = NicenessGate()
         # crash journal (Msg4.cpp:115 addsinprogress.dat): adds are
         # journaled BEFORE they are acked, replayed on restart, and the
         # journal truncates whenever the memtable state is saved — so a
@@ -310,7 +319,8 @@ class ShardNodeServer:
                 return 0
             self._heal_buffer = []
         try:
-            out = _rpc(addr, "/rpc/pull-all", {}, timeout=300.0)
+            out = _rpc(addr, "/rpc/pull-all", {}, timeout=300.0,
+                       niceness=1)
             if not out.get("ok"):
                 raise RuntimeError(out.get("error", "pull-all not ok"))
             pulled = out["rdbs"]
@@ -393,6 +403,11 @@ class ShardNodeServer:
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b"{}"
                 try:
+                    nice = int(self.headers.get("X-Niceness") or 0)
+                except ValueError:
+                    nice = 0
+                outer.nice_gate.enter(nice)
+                try:
                     payload = json.loads(body or b"{}")
                     out = outer.handle(self.path, payload)
                     code = 200
@@ -400,6 +415,8 @@ class ShardNodeServer:
                     out, code = {"error": "no such rpc"}, 404
                 except Exception as e:  # noqa: BLE001 — node must not die
                     out, code = {"error": str(e)}, 500
+                finally:
+                    outer.nice_gate.exit(nice)
                 data = json.dumps(out).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
@@ -456,10 +473,14 @@ def _decode_batch(d: dict):
 
 
 def _rpc(addr: str, path: str, payload: dict,
-         timeout: float = RPC_TIMEOUT_S) -> dict:
+         timeout: float = RPC_TIMEOUT_S, niceness: int = 0) -> dict:
+    """One JSON RPC. ``niceness`` rides an X-Niceness header (the
+    UdpProtocol.h niceness bit): 1 = background traffic the receiving
+    node may hold while interactive requests are in flight."""
     req = urllib.request.Request(
         f"http://{addr}{path}", data=json.dumps(payload).encode(),
-        headers={"Content-Type": "application/json"}, method="POST")
+        headers={"Content-Type": "application/json",
+                 "X-Niceness": str(niceness)}, method="POST")
     with urllib.request.urlopen(req, timeout=timeout) as r:
         return json.load(r)
 
@@ -516,6 +537,10 @@ class ClusterClient:
         self._stop = threading.Event()
         self._pool = ThreadPoolExecutor(
             max_workers=max(4, 2 * conf.n_shards * conf.n_replicas))
+        #: reads get their own pool: a wedged twin blocking long search
+        #: reads must not starve write delivery of workers
+        self._read_pool = ThreadPoolExecutor(
+            max_workers=max(8, 2 * conf.n_shards * conf.n_replicas))
         self._retry_thread = threading.Thread(
             target=self._retry_loop, daemon=True, name="msg1-retry")
         self._retry_thread.start()
@@ -561,8 +586,11 @@ class ClusterClient:
 
     def _deliver(self, p: _Pending) -> bool:
         try:
+            # writes are background traffic (reference Msg4 adds run at
+            # niceness 1): the receiving node lets interactive queries
+            # go first
             out = _rpc(self.conf.addresses[p.shard][p.replica], p.path,
-                       p.payload)
+                       p.payload, niceness=1)
             return bool(out.get("ok"))
         except Exception as e:  # noqa: BLE001
             log.debug("deliver to %d/%d failed: %s", p.shard, p.replica, e)
@@ -680,14 +708,20 @@ class ClusterClient:
 
     # --- reads (Multicast serving-twin pick + reroute) -------------------
 
-    def _read_shard(self, shard: int, path: str, payload: dict
-                    ) -> dict | None:
+    def _read_shard(self, shard: int, path: str, payload: dict,
+                    timeout: float = RPC_TIMEOUT_S) -> dict | None:
         """Try twins in (liveness, least-observed-latency) order; mark
         failures dead and reroute (Multicast.cpp:520 — the reference
         likewise prefers the less-loaded twin via its ping/load info).
         None = whole shard down. The EWMA of per-read latency is the
         load signal: a twin bogged down by a merge or a heal answers
-        slower and organically sheds read traffic to its sibling."""
+        slower and organically sheds read traffic to its sibling.
+
+        A failed read dead-marks the host only when a follow-up ping
+        ALSO fails — one slow deep-paging query must not take a
+        healthy twin out of rotation (the reference distinguishes
+        request timeout from host death the same way: PingServer owns
+        liveness, Multicast only reroutes)."""
         order = sorted(
             range(self.conf.n_replicas),
             key=lambda r: (not self.hostmap.alive[shard, r],
@@ -695,7 +729,8 @@ class ClusterClient:
         for r in order:
             t0 = time.monotonic()
             try:
-                out = _rpc(self.conf.addresses[shard][r], path, payload)
+                out = _rpc(self.conf.addresses[shard][r], path,
+                           payload, timeout=timeout)
                 if out.get("ok") or "total" in out:
                     self.hostmap.mark_alive(shard, r)
                     dt = time.monotonic() - t0
@@ -703,7 +738,12 @@ class ClusterClient:
                         0.8 * self._read_ewma[shard][r] + 0.2 * dt)
                     return out
             except Exception:  # noqa: BLE001
-                self.hostmap.mark_dead(shard, r)
+                if self._ping(shard, r):
+                    # alive but slow/failed on this request: penalize
+                    # its load signal, try the twin, keep it alive
+                    self._read_ewma[shard][r] += 1.0
+                else:
+                    self.hostmap.mark_dead(shard, r)
         return None
 
     def get_document(self, docid: int) -> dict | None:
@@ -724,16 +764,22 @@ class ClusterClient:
 
         want = max(topk + offset, PQR_SCAN)
         over = max(want * 2, 16)
-        futs = [self._pool.submit(
+        futs = [self._read_pool.submit(
             self._read_shard, s, "/rpc/search",
-            {"q": q, "topk": over, "lang": lang})
+            {"q": q, "topk": over, "lang": lang}, SEARCH_TIMEOUT_S)
             for s in range(self.conf.n_shards)]
         total = 0
         docids: list[int] = []
         scores: list[float] = []
         degraded = False
         for f in futs:
-            out = f.result()
+            try:
+                # overall deadline: one wedged shard degrades the
+                # answer instead of hanging the caller for the full
+                # per-twin timeout ladder
+                out = f.result(timeout=SEARCH_TIMEOUT_S + 5.0)
+            except Exception:  # noqa: BLE001 — timeout → partial
+                out = None
             if out is None:
                 degraded = True  # whole shard down: partial answer
                 continue
@@ -748,7 +794,8 @@ class ClusterClient:
         # Msg40::launchMsg20s); build_results then reads the cache
         prefetch = [docids[i] for i in order[: want + 8]]
         fetched = dict(zip(prefetch,
-                           self._pool.map(self.get_document, prefetch)))
+                           self._read_pool.map(self.get_document,
+                                               prefetch)))
         get_doc = lambda d: fetched.get(d) if d in fetched \
             else self.get_document(d)
         results, clustered = build_results(

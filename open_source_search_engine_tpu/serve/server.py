@@ -232,6 +232,9 @@ class SearchHTTPServer:
         self._ab_lock = threading.Lock()
         self._ab_hits: dict[str, list[float]] = {}
         self._ab_banned: dict[str, float] = {}
+        #: niceness gate: background requests yield to interactive
+        from ..utils.nice import NicenessGate
+        self.nice_gate = NicenessGate()
 
     BAN_COOLDOWN_S = 60.0
 
@@ -279,9 +282,22 @@ class SearchHTTPServer:
     # --- request handling -------------------------------------------------
 
     def handle(self, method: str, path: str, query: dict,
-               body: bytes, client_ip: str = "") -> tuple[int, str, str]:
+               body: bytes, client_ip: str = "",
+               niceness: int = 0) -> tuple[int, str, str]:
         """Route one request → (status, payload, content_type).
-        The Pages.cpp s_pages[] table, as a method."""
+        The Pages.cpp s_pages[] table, as a method. Background
+        (niceness-1) requests yield to in-flight interactive ones
+        (UdpProtocol.h niceness bit)."""
+        self.nice_gate.enter(niceness)
+        try:
+            return self._handle_inner(method, path, query, body,
+                                      client_ip)
+        finally:
+            self.nice_gate.exit(niceness)
+
+    def _handle_inner(self, method: str, path: str, query: dict,
+                      body: bytes, client_ip: str = ""
+                      ) -> tuple[int, str, str]:
         try:
             if path == "/":
                 return 200, self._page_root(), "text/html"
@@ -724,9 +740,13 @@ class SearchHTTPServer:
                 query = dict(urllib.parse.parse_qsl(parsed.query))
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
+                try:
+                    nice = int(self.headers.get("X-Niceness") or 0)
+                except ValueError:
+                    nice = 0
                 status, payload, ctype = outer.handle(
                     method, parsed.path, query, body,
-                    client_ip=self.client_address[0])
+                    client_ip=self.client_address[0], niceness=nice)
                 data = payload.encode("utf-8")
                 self.send_response(status)
                 self.send_header("Content-Type", ctype + "; charset=utf-8")
@@ -741,6 +761,22 @@ class SearchHTTPServer:
                 self._serve("POST")
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        # TLS plane (reference links -lssl and serves https off gb.pem,
+        # TcpServer.cpp / Makefile:113): wrap the listening socket when
+        # a cert is configured — same handler, same port semantics
+        cert = getattr(self.conf, "ssl_cert", "") or ""
+        if cert:
+            import ssl as _ssl
+            ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(
+                cert, keyfile=getattr(self.conf, "ssl_key", "") or None)
+            # handshake on first READ (in the per-connection handler
+            # thread), NOT in accept(): a stalled ClientHello must not
+            # block the single accept loop for every other client
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
+            log.info("TLS enabled (cert=%s)", cert)
         self.port = self._httpd.server_address[1]  # resolve port 0
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)

@@ -60,6 +60,8 @@ PARMS: list[Parm] = [
     _p("query_max_terms", "qmax", int, 64, GLOBAL, "max query terms (reference ABS_MAX_QUERY_TERMS=9000, Query.h:43; ours is the padded device width)"),
     _p("dns_servers", "dns", str, "", GLOBAL, "DNS resolver ips (Conf dns parms)"),
     _p("master_password", "mpwd", str, "", GLOBAL, "admin master password; empty = open (Conf::m_masterPwds, PageLogin)", broadcast=False),
+    _p("ssl_cert", "sslcert", str, "", GLOBAL, "TLS certificate chain path (gb.pem role, TcpServer.cpp SSL) — empty serves plaintext", broadcast=False),
+    _p("ssl_key", "sslkey", str, "", GLOBAL, "TLS private key path (empty = key inside ssl_cert)", broadcast=False),
     _p("serve_device", "sdev", bool, True, GLOBAL, "serve /search from the HBM-resident index with micro-batching (SURVEY §7.8 throughput mode)"),
     _p("merge_quiet_hours", "mergehours", str, "", GLOBAL, "DailyMerge window (DailyMerge.h:11)"),
     # --- per-collection (coll.conf / CollectionRec) ---
