@@ -443,12 +443,28 @@ def build_meta_list(
     if delete:
         title_rec = b""  # tombstone payload; skip the pointless compress
     else:
+        # first heading run → the h1 title-fallback source (Title.cpp
+        # falls back title → h1 → anchor → url; stored as lowercased
+        # tokens — the tokenizer's columnar stream is the one source
+        # both the python and native paths share). Vectorized: one
+        # nonzero over the hashgroup column, not a per-token loop.
+        h1 = ""
+        hgarr = nat.hashgroup if nat is not None else \
+            np.asarray(tdoc.hashgroups, dtype=np.uint64)
+        hidx = np.nonzero(hgarr == posdb.HASHGROUP_HEADING)[0]
+        if len(hidx):
+            a = int(hidx[0])
+            k = 0  # length of the contiguous first run, capped at 16
+            while k < min(len(hidx), 16) and int(hidx[k]) == a + k:
+                k += 1
+            h1 = " ".join(tdoc.words[a:a + k])
         title_rec = titledb.make_title_rec(
             url=u.full, title=tdoc.title.strip(), text=tdoc.text,
             links=tdoc.links, site=site, langid=langid, siterank=siterank,
             content_hash=content_hash,
             ts=ts if ts is not None else time.time(),
             extra={"content": content, "is_html": is_html,
+                   "h1": h1,
                    "meta_description": tdoc.meta_description,
                    "inlinks": [[t, sr] for t, sr in inlinks],
                    "linkee_sites": linkee_sites,

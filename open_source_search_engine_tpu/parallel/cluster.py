@@ -807,7 +807,7 @@ class ClusterClient:
             results, offset=offset, topk=topk, conf=conf, qlang=lang,
             get_doc=get_doc,
             langid_of=lambda d: (fetched.get(d) or {}).get("langid", 0),
-            words=[g.display for g in plan.scored_groups],
+            words=plan.match_words(),
             with_snippets=with_snippets)
         return SearchResults(
             query=q, total_matches=total, results=page,

@@ -605,7 +605,7 @@ def sharded_search(sc: ShardedCollection, q: str | QueryPlan, *,
         get_doc=sc.get_document,
         langid_of=lambda d: _coll_langid_of(
             sc.shards[int(sc.hostmap.shard_of_docid(d))])(d),
-        words=[g.display for g in plan.scored_groups],
+        words=plan.match_words(),
         with_snippets=with_snippets)
     return SearchResults(
         query=plan.raw, total_matches=int(total), results=page,
@@ -711,7 +711,7 @@ class MeshResident:
                 langid_of=lambda d: self.indexes[
                     int(sc.hostmap.shard_of_docid(d))].langid_of(d),
                 get_doc=sc.get_document,
-                words=[g.display for g in plan.scored_groups],
+                words=plan.match_words(),
                 with_snippets=with_snippets)
             from ..query.engine import compute_facets
             out.append(SearchResults(
@@ -734,7 +734,8 @@ def suggest_sharded(sc: ShardedCollection, plan: QueryPlan) -> str | None:
     docs, so the Msg3a layer merges counts). The merged view is cached
     per topology+corpus version — zero-result queries must stay cheap."""
     from ..query.speller import merged
-    words = [g.display for g in plan.scored_groups if " " not in g.display]
+    words = [g.display for g in plan.scored_groups
+             if " " not in g.display and ":" not in g.display]
     if not words:
         return None
     live = [sc.grid[s][r].speller

@@ -170,6 +170,23 @@ class QueryPlan:
     def scored_groups(self) -> list[TermGroup]:
         return [g for g in self.groups if g.scored and not g.negative]
 
+    def match_words(self) -> list[str]:
+        """Every single word a match/highlight pass should light up:
+        the scored groups' originals AND their conjugate forms
+        (Matches.cpp matches synonym forms too — "run" highlights
+        "running"). Bigram displays ("a b") and fielded displays
+        ("site:x") are skipped: they never equal a single token."""
+        out: list[str] = []
+        seen: set[str] = set()
+        for g in self.scored_groups:
+            for d in [g.display] + [s.display for s in g.sublists]:
+                d = (d or "").lower()
+                if d and " " not in d and ":" not in d \
+                        and not d.startswith('"') and d not in seen:
+                    seen.add(d)
+                    out.append(d)
+        return out
+
     @property
     def num_terms(self) -> int:
         return len(self.groups)
@@ -303,10 +320,12 @@ def _conjugates(w: str) -> list[str]:
         add(w + "s")
     if w.endswith("ing") and len(w) > 5:
         base = w[:-3]
+        if len(base) > 2 and base[-1] == base[-2]:
+            add(base[:-1])  # running → run — BEFORE the raw base:
+            # the MAX_SYNONYMS cap must not cut the real word for
+            # the doubled-consonant artifact ("runn")
         add(base)
         add(base + "e")
-        if len(base) > 2 and base[-1] == base[-2]:
-            add(base[:-1])  # running → run
     elif w.endswith("ed") and len(w) > 4:
         add(w[:-2])
         add(w[:-1])

@@ -80,7 +80,7 @@ def build_results(get_doc, docids, scores, plan: QueryPlan, *,
     the mesh path). Returns (results, number hidden by cluster/dedup)."""
     from . import summary as summary_mod
 
-    words = [g.display for g in plan.scored_groups]
+    words = plan.match_words()
     per_site: dict = {}
     seen_hashes: set[int] = set()
     results: list[Result] = []
@@ -102,7 +102,8 @@ def build_results(get_doc, docids, scores, plan: QueryPlan, *,
         r = Result(docid=int(docid), score=float(score))
         if rec:
             r.url = rec.get("url", "")
-            r.title = rec.get("title", "")
+            # Title.cpp fallback chain: title → h1 → anchor → url
+            r.title = summary_mod.choose_title(rec)
             r.site = rec.get("site", "")
             ch = rec.get("content_hash")
             if dedup_content and ch is not None:
@@ -122,7 +123,8 @@ def build_results(get_doc, docids, scores, plan: QueryPlan, *,
                 per_site[r.site] = seen + 1
         if rec and with_snippets:
             r.snippet = summary_mod.make_summary(
-                rec.get("text", ""), words)
+                rec.get("text", ""), words,
+                description=rec.get("meta_description", ""))
         results.append(r)
     return results, clustered
 
@@ -183,7 +185,8 @@ def finish_page(results, *, offset: int, topk: int, conf=None,
                 rec = get_doc(int(r.docid))
                 if rec:
                     r.snippet = summary_mod.make_summary(
-                        rec.get("text", ""), words or [])
+                        rec.get("text", ""), words or [],
+                        description=rec.get("meta_description", ""))
     return page
 
 
@@ -244,7 +247,7 @@ def search(coll: Collection, q: str | QueryPlan, *, topk: int = 10,
         results, offset=offset, topk=topk, conf=coll.conf,
         qlang=plan.lang, langid_of=_coll_langid_of(coll),
         get_doc=lambda d: docproc.get_document(coll, docid=d),
-        words=[g.display for g in plan.scored_groups],
+        words=plan.match_words(),
         with_snippets=with_snippets)
     return SearchResults(
         query=raw, total_matches=total, results=page,
@@ -278,7 +281,8 @@ def compute_facets(plan: QueryPlan, docids, get_doc) -> dict:
 def _suggest(coll: Collection, plan: QueryPlan) -> str | None:
     """Zero-result fallback: Speller "did you mean" over the query's
     scored words (reference Msg40 spell-check integration)."""
-    words = [g.display for g in plan.scored_groups if " " not in g.display]
+    words = [g.display for g in plan.scored_groups
+             if " " not in g.display and ":" not in g.display]
     return coll.speller.suggest_query(words) if words else None
 
 
@@ -374,7 +378,7 @@ def search_device_batch(coll: Collection, queries, *, topk: int = 10,
             results, offset=offset, topk=topk, conf=coll.conf,
             qlang=plan.lang, langid_of=di.langid_of,
             get_doc=lambda d: docproc.get_document(coll, docid=d),
-            words=[g.display for g in plan.scored_groups],
+            words=plan.match_words(),
             with_snippets=with_snippets)
         out.append(SearchResults(
             query=plan.raw, total_matches=n_matched, results=page,

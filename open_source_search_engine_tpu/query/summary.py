@@ -35,23 +35,97 @@ def _tokenize_with_spans(text: str) -> tuple[list[str], list[tuple[int, int]]]:
     return words, spans
 
 
+_SENT_BOUND_RE = re.compile(r"[.!?]\s")
+
+
+def choose_title(rec: dict, max_len: int = 80) -> str:
+    """Title fallback chain (``Title.cpp``): stored <title> → first
+    heading (h1) → best inlink anchor text → url-derived words. Never
+    returns empty for a doc with a url."""
+    for cand in (rec.get("title"), rec.get("h1")):
+        if cand and cand.strip():
+            return cand.strip()[:max_len]
+    # longest anchor text under the cap (Title.cpp scores link texts)
+    anchors = sorted((t for t, _sr in (rec.get("inlinks") or []) if t),
+                     key=len, reverse=True)
+    for a in anchors:
+        if a.strip():
+            return a.strip()[:max_len]
+    url = rec.get("url", "")
+    if url:
+        from ..utils.url import normalize
+        try:
+            u = normalize(url)
+            tail = [s for s in u.path.split("/") if s]
+            seg = tail[-1] if tail else ""
+            base, dot, ext = seg.rpartition(".")
+            if dot and base and len(ext) <= 5:
+                seg = base  # drop the file extension from the title
+            # [^\W_]: url slugs separate words with _ as often as -
+            words = re.findall(r"[^\W_]+", seg)
+            if words:
+                return " ".join(words)[:max_len]
+            return u.host[:max_len]
+        except Exception:  # noqa: BLE001 — junk urls
+            return url[:max_len]
+    return ""
+
+
+def field_matches(rec: dict, query_words: list[str]) -> dict[str, int]:
+    """Field-aware match positions (``Matches.cpp`` MF_* flags): how
+    many distinct query words hit each stored field — a reporting
+    helper for result renderers that highlight per-field (the summary
+    source choice itself lives in make_summary's fallback chain)."""
+    qset = {w.lower() for w in query_words if w}
+    out: dict[str, int] = {}
+    fields = {
+        "title": rec.get("title", ""),
+        "h1": rec.get("h1", ""),
+        "description": rec.get("meta_description", ""),
+        "body": rec.get("text", ""),
+        "anchor": " ".join(t for t, _ in (rec.get("inlinks") or [])),
+    }
+    for name, val in fields.items():
+        if not val:
+            continue
+        hits = {w for w in
+                (m.group(0).lower() for m in _WORD_RE.finditer(val))
+                if w in qset}
+        if hits:
+            out[name] = len(hits)
+    return out
+
+
 def make_summary(text: str, query_words: list[str], *,
                  max_fragments: int = 2, window: int = WINDOW_WORDS,
-                 max_chars: int = 320) -> str:
-    """Pick the best-scoring excerpt windows for these query words."""
-    if not text:
+                 max_chars: int = 320, description: str = "") -> str:
+    """Pick the best-scoring excerpt windows for these query words.
+
+    Fallback order when the body has no match (Summary.cpp's source
+    chain): the meta description if IT matches, else the text head,
+    else the description itself."""
+    if not text and not description:
         return ""
     qset = {w.lower() for w in query_words if w}
     if not qset:
-        return text[:max_chars].strip()
+        return (text or description)[:max_chars].strip()
     words, spans = _tokenize_with_spans(text)
+
+    def _fallback() -> str:
+        if description:
+            dwords = {m.group(0).lower()
+                      for m in _WORD_RE.finditer(description)}
+            if dwords & qset or not text:
+                return description[:max_chars].strip()
+        return (text or description)[:max_chars].strip()
+
     if not words:
-        return text[:max_chars].strip()
+        return _fallback()
     n = len(words)
     warr = np.array(words)
     hit = np.isin(warr, list(qset))
     if not hit.any():
-        return text[:max_chars].strip()
+        return _fallback()
 
     # term ids for distinct-term counting inside windows
     qlist = sorted(qset)
@@ -84,19 +158,30 @@ def make_summary(text: str, query_words: list[str], *,
         sc[s:best + win] = -1.0
     frags.sort()
 
+    # sentence boundaries computed once: fragments snap to REAL
+    # sentence bounds (within a slack) instead of raw window edges
+    bounds = [0] + [m.end() for m in _SENT_BOUND_RE.finditer(text)] \
+        + [len(text)]
+    barr = np.array(bounds)
+
     parts = []
     used = 0
     for lo, hi in frags:
         clo, chi = spans[lo][0], spans[hi - 1][1]
-        # extend to sentence-ish boundaries within a small slack
-        head = text.rfind(". ", max(0, clo - 60), clo)
-        clo2 = head + 2 if head >= 0 else clo
-        tail = text.find(". ", chi, chi + 60)
-        chi2 = tail + 1 if tail >= 0 else chi
+        # nearest sentence start at/before clo (slack-capped so one
+        # run-on sentence can't balloon the fragment)
+        i = int(np.searchsorted(barr, clo, side="right")) - 1
+        head = int(barr[max(i, 0)])
+        snap_head = clo - head <= 80
+        clo2 = head if snap_head else clo
+        j = int(np.searchsorted(barr, chi, side="left"))
+        tail = int(barr[min(j, len(bounds) - 1)])
+        snap_tail = tail - chi <= 80
+        chi2 = tail if snap_tail else chi
         frag = text[clo2:chi2].strip()
-        if clo2 > 0 and head < 0:
+        if clo2 > 0 and not snap_head:
             frag = "…" + frag
-        if chi2 < len(text) and tail < 0:
+        if chi2 < len(text) and not snap_tail:
             frag += "…"
         if used + len(frag) > max_chars and parts:
             break

@@ -201,7 +201,8 @@ def test_heal_single_cut_replays_pull_window_writes(tmp_path,
     a.start()
     real_rpc = cl._rpc
 
-    def rpc_with_concurrent_write(addr, path, payload, timeout=10.0):
+    def rpc_with_concurrent_write(addr, path, payload, timeout=10.0,
+                                  niceness=0):
         # deliver a write to the HEALING node mid-pull: it lands after
         # the buffer is armed and before the snapshot applies
         b.handle("/rpc/index", {

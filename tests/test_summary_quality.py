@@ -1,0 +1,101 @@
+"""Summary/Title quality goldens: the Title.cpp fallback chain,
+field-aware matches, sentence-snapped fragments, conjugate-aware
+highlighting, and the meta-description summary fallback."""
+
+import tempfile
+
+from open_source_search_engine_tpu.build import docproc
+from open_source_search_engine_tpu.index.collection import Collection
+from open_source_search_engine_tpu.query import engine
+from open_source_search_engine_tpu.query.compiler import compile_query
+from open_source_search_engine_tpu.query.summary import (
+    choose_title, field_matches, highlight, make_summary)
+
+
+class TestTitleFallback:
+    def test_stored_title_wins(self):
+        assert choose_title({"title": "Real Title", "h1": "heading",
+                             "url": "http://x.test/a"}) == "Real Title"
+
+    def test_h1_fallback(self):
+        assert choose_title({"title": "", "h1": "the heading words",
+                             "url": "http://x.test/a"}) \
+            == "the heading words"
+
+    def test_anchor_fallback(self):
+        rec = {"title": "", "h1": "",
+               "inlinks": [["short", 3], ["a longer anchor text", 5]],
+               "url": "http://x.test/a"}
+        assert choose_title(rec) == "a longer anchor text"
+
+    def test_url_fallback(self):
+        rec = {"title": "", "h1": "", "inlinks": [],
+               "url": "http://x.test/deep/path/red-pandas_guide"}
+        assert choose_title(rec) == "red pandas guide"
+
+    def test_host_fallback_when_no_path(self):
+        rec = {"title": "", "h1": "", "url": "http://bare.test/"}
+        assert "bare.test" in choose_title(rec)
+
+    def test_truncation(self):
+        rec = {"title": "x" * 300, "url": "http://x.test/"}
+        assert len(choose_title(rec, max_len=80)) == 80
+
+    def test_end_to_end_titleless_page(self, tmp_path):
+        coll = Collection("t", str(tmp_path))
+        docproc.index_document(
+            coll, "http://t.test/no-title-page",
+            "<html><body><h1>Pandas In The Wild</h1>"
+            "<p>pandas eat bamboo happily in mountain forests.</p>"
+            "</body></html>")
+        res = engine.search(coll, "bamboo", topk=5)
+        assert res.results
+        assert res.results[0].title == "pandas in the wild"
+
+
+class TestFieldMatches:
+    def test_per_field_counts(self):
+        rec = {"title": "Tiger Story", "h1": "",
+               "meta_description": "about big tigers",
+               "text": "the tiger hunts at night",
+               "inlinks": [["tiger page", 2]]}
+        fm = field_matches(rec, ["tiger", "night"])
+        assert fm["title"] == 1       # "tiger" (lowercased match)
+        assert fm["body"] == 2        # tiger + night
+        assert fm["anchor"] == 1
+        assert "h1" not in fm
+
+
+class TestSummary:
+    TEXT = ("The quick brown fox jumps over the lazy dog. "
+            "Nothing about cats here at all in this one. "
+            "A second sentence mentions foxes and badgers together. "
+            "Filler filler filler words continue for a while longer. "
+            "The final sentence is about weather patterns.")
+
+    def test_sentence_snapped(self):
+        s = make_summary(self.TEXT, ["badgers"])
+        # the fragment snaps to the containing sentence's bounds
+        assert "A second sentence mentions foxes and badgers" in s
+        assert not s.startswith("…")
+
+    def test_description_fallback_when_body_misses(self):
+        s = make_summary("body text without the word.", ["zebra"],
+                         description="zebra facts and figures")
+        assert s == "zebra facts and figures"
+
+    def test_body_head_when_nothing_matches(self):
+        s = make_summary("just some body text here.", ["zebra"],
+                         description="nothing relevant either")
+        assert s.startswith("just some body")
+
+    def test_conjugate_words_matched(self):
+        plan = compile_query("running")
+        words = plan.match_words()
+        assert "running" in words
+        assert "run" in words          # conjugate rides along
+        s = make_summary("she was seen run after the bus daily.",
+                         words)
+        assert "run" in s
+        h = highlight("run and running", words)
+        assert h == "<b>run</b> and <b>running</b>"
