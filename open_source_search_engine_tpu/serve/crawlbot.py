@@ -61,8 +61,9 @@ class CrawlBot:
 
     def __init__(self, colldb, fetcher_factory=None):
         self.colldb = colldb
-        #: injectable for tests (FakeFetcher); None = real Fetcher
-        self.fetcher_factory = fetcher_factory or Fetcher
+        #: injectable for tests (FakeFetcher); None = a real Fetcher
+        #: with the collection's SpiderProxy pool
+        self.fetcher_factory = fetcher_factory
         self.jobs: dict[str, CrawlJob] = {}
         self._lock = threading.Lock()
 
@@ -79,7 +80,9 @@ class CrawlBot:
                 max_hops=max_hops, same_host_only=same_host_only,
                 banned=coll.tagdb.is_banned)
             loop = SpiderLoop(coll, scheduler=sched,
-                              fetcher=self.fetcher_factory())
+                              fetcher=(self.fetcher_factory()
+                                       if self.fetcher_factory
+                                       else None))
             job = CrawlJob(name=name, loop=loop, max_pages=max_pages)
             self.jobs[name] = job
 

@@ -38,6 +38,9 @@ class FetchResult:
     content: str = ""
     content_type: str = ""
     error: str = ""
+    #: undecoded body for binary document types (pdf/doc/ps) — the
+    #: converter plane (build/convert.py) turns it into text
+    raw: bytes = b""
 
     @property
     def ok(self) -> bool:
@@ -157,7 +160,8 @@ class Fetcher:
 
     def __init__(self, n_threads: int = 8, timeout: float = 10.0,
                  respect_robots: bool = True,
-                 cache_ttl_s: float = 3600.0):
+                 cache_ttl_s: float = 3600.0,
+                 proxies=None):
         self.pool = ThreadPoolExecutor(max_workers=n_threads,
                                        thread_name_prefix="fetch")
         self.timeout = timeout
@@ -165,6 +169,8 @@ class Fetcher:
         self.robots = RobotsCache()
         self.cache = ResponseCache(ttl_s=cache_ttl_s) \
             if cache_ttl_s > 0 else None
+        #: SpiderProxy pool (spider/proxies.py) — None/empty = direct
+        self.proxies = proxies
 
     def fetch_one(self, url: str) -> FetchResult:
         if self.cache is not None:
@@ -173,22 +179,71 @@ class Fetcher:
                 return hit
         if self.respect_robots and not self.robots.allowed(url):
             return FetchResult(url=url, status=999, error="robots.txt")
+        # proxy assignment per target first-IP (SpiderProxy.h:27); a
+        # response that reads as a ban page rotates to the next proxy
+        tries = 1
+        target_ip = ""
+        if self.proxies:
+            from ..utils import ipresolve
+            target_ip = ipresolve.first_ip(
+                urllib.parse.urlsplit(url).hostname or "")
+            tries = 3
+        banned_all = False
+        for _ in range(tries):
+            proxy = self.proxies.pick(target_ip) if self.proxies \
+                else None
+            try:
+                res = self._get(url, proxy)
+            finally:
+                if proxy:
+                    self.proxies.release(proxy)
+            if proxy and res.status == 0:
+                # dead/unreachable proxy: cool the pair down exactly
+                # like a ban so the sticky assignment rotates away
+                self.proxies.report(proxy, target_ip, 403, "")
+                banned_all = True
+                continue
+            if proxy and self.proxies.report(
+                    proxy, target_ip, res.status, res.content):
+                banned_all = True
+                continue  # banned pair cooled down — next proxy
+            if self.cache is not None and res.ok:
+                self.cache.put(url, res)
+            return res
+        # every proxy try banned/failed: surface an ERROR, never the
+        # ban interstitial as content (the reference treats ban pages
+        # as fetch failures — indexing a captcha page poisons the doc)
+        return FetchResult(url=url, status=0,
+                           error="ban page or dead proxy via every "
+                                 "assigned proxy"
+                                 if banned_all else "proxy fetch failed")
+
+    def _get(self, url: str, proxy: str | None) -> FetchResult:
         req = urllib.request.Request(url, headers={
             "User-Agent": USER_AGENT, "Accept-Encoding": "gzip"})
+        opener = urllib.request.build_opener(
+            urllib.request.ProxyHandler(
+                {"http": f"http://{proxy}",
+                 "https": f"http://{proxy}"})) if proxy \
+            else urllib.request.build_opener()
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            with opener.open(req, timeout=self.timeout) as r:
                 data = r.read(MAX_DOC_BYTES)
                 if r.headers.get("Content-Encoding") == "gzip":
                     data = _gunzip_capped(data)
+                ctype = r.headers.get_content_type()
+                from ..build.convert import is_convertible
+                if is_convertible(ctype, r.url):
+                    # binary document: keep bytes for the converters
+                    return FetchResult(
+                        url=r.url, status=r.status, raw=data,
+                        content_type=ctype)
                 charset = sniff_charset(
                     data, r.headers.get_content_charset())
-                res = FetchResult(
+                return FetchResult(
                     url=r.url, status=r.status,
                     content=data.decode(charset, "replace"),
-                    content_type=r.headers.get_content_type())
-                if self.cache is not None and res.ok:
-                    self.cache.put(url, res)
-                return res
+                    content_type=ctype)
         except urllib.error.HTTPError as e:
             return FetchResult(url=url, status=e.code, error=str(e))
         except Exception as e:  # noqa: BLE001 — network errors are data

@@ -48,7 +48,15 @@ class SpiderLoop:
         # makes it falsy) — a durable frontier always starts empty
         self.sched = scheduler if scheduler is not None \
             else SpiderScheduler(banned=self._tagdb_banned)
-        self.fetcher = fetcher or Fetcher()
+        if fetcher is None:
+            # SpiderProxy pool from the collection conf (spider_proxies
+            # parm) — empty pool means direct fetching
+            from .proxies import ProxyPool
+            conf = getattr(coll_or_sharded, "conf", None)
+            pool = ProxyPool.from_conf(conf) if conf is not None \
+                else None
+            fetcher = Fetcher(proxies=pool if pool else None)
+        self.fetcher = fetcher
         self.batch_size = batch_size
         self.stats = CrawlStats()
 
@@ -110,8 +118,21 @@ class SpiderLoop:
                 log.debug("fetch failed %s: %s %s", req.url, res.status,
                           res.error)
                 continue
+            content, is_html = res.content, res.is_html
+            if res.raw and not content:
+                # binary document (pdf/doc/ps): converter plane
+                # (XmlDoc.cpp:19206 shells to pdftohtml/antiword)
+                from ..build.convert import convert_to_text
+                text = convert_to_text(res.raw, res.content_type,
+                                       res.url)
+                if not text:
+                    self.stats.errors += 1
+                    log.debug("unconvertible %s (%s)", req.url,
+                              res.content_type)
+                    continue
+                content, is_html = text, False
             try:
-                ml = self._index(res.url, res.content, res.is_html)
+                ml = self._index(res.url, content, is_html)
                 if ml is None:  # tagdb manualban (EDOCBANNED)
                     self.stats.errors += 1
                     continue

@@ -1,0 +1,133 @@
+"""SpiderProxy — external crawl-proxy pool with ban detection.
+
+Reference: ``SpiderProxy.h:27`` / ``SpiderProxy.cpp`` (msg 0x54/0x55):
+host #0 keeps the proxy table, assigns a proxy per (target first-IP)
+so load spreads and one website sees a stable exit, counts per-proxy
+outstanding downloads, and detects BAN PAGES (``SpiderProxy.cpp:1048``
+``isProxyBanPage``: captcha/forbidden markers) — a banned (proxy, IP)
+pair rotates out with a backoff while other IPs keep using the proxy.
+
+Ours is the same table, minus the UDP msg plumbing (the pool object
+lives beside the fetcher; the cluster's crawl plane is per-shard, so
+each node owns the pool for the IPs it crawls — the reference
+centralizes only because its spider shards couldn't share state).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..utils.log import get_logger
+
+log = get_logger("proxy")
+
+#: a banned (proxy, ip) pair sits out this long (the reference ages
+#: ban state in its proxy table)
+BAN_COOLDOWN_S = 600.0
+
+#: ban-page markers (isProxyBanPage scans the content for captcha /
+#: access-denied boilerplate; status 403/429 counts on its own)
+_BAN_RE = re.compile(
+    r"captcha|access denied|forbidden|unusual traffic|"
+    r"blocked|are you a robot", re.IGNORECASE)
+_BAN_SCAN_BYTES = 4096
+
+
+def looks_banned(status: int, content: str) -> bool:
+    """Does this response read as a proxy/crawler ban page?"""
+    if status in (403, 429):
+        return True
+    if status == 200 and content and \
+            _BAN_RE.search(content[:_BAN_SCAN_BYTES]) and \
+            len(content) < 8192:
+        # short pages shouting captcha/denied are ban interstitials;
+        # long real documents may legitimately contain the words
+        return True
+    return False
+
+
+@dataclass
+class _ProxyState:
+    addr: str                 # "host:port"
+    outstanding: int = 0      # in-flight downloads through it
+    #: target-ip → ban expiry (monotonic)
+    banned_until: dict = field(default_factory=dict)
+
+
+class ProxyPool:
+    """Per-target-IP proxy assignment + ban rotation."""
+
+    def __init__(self, proxies: list[str] | None = None):
+        self._lock = threading.Lock()
+        self._proxies = [_ProxyState(p) for p in (proxies or []) if p]
+
+    @classmethod
+    def from_conf(cls, conf) -> "ProxyPool":
+        raw = getattr(conf, "spider_proxies", "") or ""
+        return cls([p.strip() for p in raw.split(",") if p.strip()])
+
+    def __bool__(self) -> bool:
+        return bool(self._proxies)
+
+    def pick(self, target_ip: str) -> str | None:
+        """The proxy for this target IP: sticky by (ip-hash) so a site
+        sees a stable exit, skipping banned pairs, preferring the
+        least-loaded among candidates (the reference counts
+        outstanding downloads per proxy)."""
+        with self._lock:
+            if not self._proxies:
+                return None
+            now = time.monotonic()
+            n = len(self._proxies)
+            start = hash(target_ip) % n
+            order = [self._proxies[(start + i) % n] for i in range(n)]
+            live = [p for p in order
+                    if p.banned_until.get(target_ip, 0.0) <= now]
+            if not live:
+                return None  # every proxy banned for this ip: direct
+            best = min(live, key=lambda p: p.outstanding)
+            # sticky preference: the hash-chosen proxy wins unless it
+            # is markedly more loaded than the least-loaded candidate
+            chosen = live[0] if live[0].outstanding \
+                <= best.outstanding + 4 else best
+            chosen.outstanding += 1
+            return chosen.addr
+
+    def release(self, addr: str) -> None:
+        with self._lock:
+            for p in self._proxies:
+                if p.addr == addr and p.outstanding > 0:
+                    p.outstanding -= 1
+                    return
+
+    def report(self, addr: str, target_ip: str, status: int,
+               content: str = "") -> bool:
+        """Feed a response back; returns True when it read as a ban
+        (the pair is cooled down and the caller should retry through
+        the next proxy)."""
+        banned = looks_banned(status, content)
+        if banned:
+            with self._lock:
+                for p in self._proxies:
+                    if p.addr == addr:
+                        p.banned_until[target_ip] = \
+                            time.monotonic() + BAN_COOLDOWN_S
+                        log.info("proxy %s banned for ip %s "
+                                 "(status %d)", addr, target_ip,
+                                 status)
+                        break
+        return banned
+
+    def status(self) -> list[dict]:
+        """Admin view (the reference's proxy table page)."""
+        now = time.monotonic()
+        with self._lock:
+            return [{
+                "addr": p.addr,
+                "outstanding": p.outstanding,
+                "banned_ips": sum(1 for t in p.banned_until.values()
+                                  if t > now),
+            } for p in self._proxies]

@@ -32,6 +32,23 @@ _lock = threading.Lock()
 #: test/offline hook: set to a callable host → ip-string
 resolver_override = None
 
+_wire = None
+
+
+def _wire_resolver():
+    """The wire-protocol DnsResolver when DNS servers are configured
+    (OSSE_DNS_SERVERS env / dns_servers parm); None = OS resolver."""
+    global _wire
+    if _wire is None:
+        import os
+
+        from .dnsresolver import DnsResolver
+        servers = [s for s in
+                   os.environ.get("OSSE_DNS_SERVERS", "").split(",")
+                   if s]
+        _wire = DnsResolver(servers) if servers else False
+    return _wire or None
+
 
 def _pseudo_ip(host: str) -> str:
     """Deterministic fallback for unresolvable hosts: a reserved-range
@@ -69,6 +86,11 @@ def first_ip(host: str, timeout: float = 5.0) -> str:
     try:
         if resolver_override is not None:
             ip = resolver_override(host)
+        elif (wire := _wire_resolver()) is not None:
+            # configured DNS servers → the wire resolver owns the
+            # lookup (per-record TTLs, timeout budget, Dns.cpp role)
+            ip = wire.resolve(host, budget_s=timeout) \
+                or _pseudo_ip(host)
         else:
             # getaddrinfo has no timeout parameter and can hang for
             # minutes on a broken resolver path — bound it with a
@@ -100,6 +122,8 @@ def first_ip(host: str, timeout: float = 5.0) -> str:
 
 
 def clear_cache() -> None:
+    global _wire
     with _lock:
         _cache.clear()
         _inflight.clear()
+        _wire = None  # re-read OSSE_DNS_SERVERS on next lookup

@@ -71,6 +71,7 @@ PARMS: list[Parm] = [
     _p("spider_max_pages", "maxpages", int, 0, COLL, "crawl page quota (CollectionRec::m_maxToCrawl)"),
     _p("spider_delay_ms", "sdelay", int, 1000, COLL, "same-IP politeness wait (Spider.cpp wait tree)"),
     _p("max_spiders", "maxspiders", int, 8, COLL, "concurrent fetches (Spider.h MAX_SPIDERS)"),
+    _p("spider_proxies", "sproxies", str, "", COLL, "comma-separated crawl proxy host:port pool (SpiderProxy.h:27); empty = direct"),
     _p("lang_weight", "langw", float, 20.0, COLL, "same-language score boost (Posdb.cpp SAMELANGMULT)"),
     _p("title_max_len", "tml", int, 80, COLL, "title truncation (Title.cpp)"),
     _p("summary_excerpts", "ns", int, 3, COLL, "summary excerpt count (Summary.h)"),

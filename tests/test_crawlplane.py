@@ -1,0 +1,346 @@
+"""Crawl-plane reality: wire DNS with TTLs, SpiderProxy rotation, and
+binary-document converters (VERDICT r4 item 6; reference Dns.cpp,
+SpiderProxy.cpp:1048, XmlDoc.cpp:19206-19227)."""
+
+import socket
+import struct
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from open_source_search_engine_tpu.build.convert import (
+    convert_to_text, is_convertible, pdf_text_builtin)
+from open_source_search_engine_tpu.spider.fetcher import (Fetcher,
+                                                          FetchResult)
+from open_source_search_engine_tpu.spider.proxies import (ProxyPool,
+                                                          looks_banned)
+from open_source_search_engine_tpu.utils import dnsresolver
+from open_source_search_engine_tpu.utils.dnsresolver import (
+    QTYPE_A, QTYPE_CNAME, QTYPE_NS, DnsResolver, build_query,
+    parse_response)
+
+
+# --------------------------------------------------------------- DNS
+
+
+def _name_bytes(name: str) -> bytes:
+    out = b""
+    for lb in name.strip(".").split("."):
+        out += bytes([len(lb)]) + lb.encode()
+    return out + b"\x00"
+
+
+def _rr(name: str, rtype: int, ttl: int, rdata: bytes) -> bytes:
+    return (_name_bytes(name) +
+            struct.pack(">HHIH", rtype, 1, ttl, len(rdata)) + rdata)
+
+
+def _response(query: bytes, answers=(), authority=(), additional=(),
+              rcode: int = 0) -> bytes:
+    qid = struct.unpack(">H", query[:2])[0]
+    # echo the question section verbatim
+    qend = 12
+    while query[qend]:
+        qend += 1 + query[qend]
+    qend += 5
+    hdr = struct.pack(">HHHHHH", qid, 0x8000 | rcode, 1,
+                      len(answers), len(authority), len(additional))
+    return hdr + query[12:qend] + b"".join(answers) + \
+        b"".join(authority) + b"".join(additional)
+
+
+class FakeDnsServer:
+    """Canned-answer UDP DNS server; ``responder(name, query)`` builds
+    the reply."""
+
+    def __init__(self, responder):
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind(("127.0.0.1", 0))
+        self.port = self.sock.getsockname()[1]
+        self.responder = responder
+        self.queries: list[str] = []
+        self._stop = False
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+        self.thread.start()
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def _loop(self):
+        self.sock.settimeout(0.2)
+        while not self._stop:
+            try:
+                data, peer = self.sock.recvfrom(4096)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            name, _ = dnsresolver._read_name(data, 12)
+            self.queries.append(name)
+            reply = self.responder(name, data)
+            if reply is not None:
+                self.sock.sendto(reply, peer)
+
+    def stop(self):
+        self._stop = True
+        self.sock.close()
+
+
+class TestDnsResolver:
+    def test_a_record_with_ttl_cached(self):
+        def responder(name, q):
+            return _response(q, answers=[
+                _rr(name, QTYPE_A, 300, socket.inet_aton("10.1.2.3"))])
+        srv = FakeDnsServer(responder)
+        try:
+            r = DnsResolver([srv.addr])
+            assert r.resolve("example.test") == "10.1.2.3"
+            assert r.resolve("example.test") == "10.1.2.3"
+            assert len(srv.queries) == 1  # second hit came from cache
+            # per-record TTL honored (not a fixed module TTL)
+            _, exp = r._cache["example.test"]
+            assert 200 < exp - time.monotonic() <= 300
+        finally:
+            srv.stop()
+
+    def test_cname_chain(self):
+        def responder(name, q):
+            if name == "www.alias.test":
+                return _response(q, answers=[
+                    _rr(name, QTYPE_CNAME, 60,
+                        _name_bytes("real.test")),
+                    _rr("real.test", QTYPE_A, 60,
+                        socket.inet_aton("10.9.9.9"))])
+            return _response(q, rcode=3)
+        srv = FakeDnsServer(responder)
+        try:
+            assert DnsResolver([srv.addr]).resolve("www.alias.test") \
+                == "10.9.9.9"
+        finally:
+            srv.stop()
+
+    def test_nxdomain_negative_cached(self):
+        def responder(name, q):
+            return _response(q, rcode=3)
+        srv = FakeDnsServer(responder)
+        try:
+            r = DnsResolver([srv.addr])
+            assert r.resolve("nope.test") is None
+            assert r.resolve("nope.test") is None
+            assert len(srv.queries) == 1
+        finally:
+            srv.stop()
+
+    def test_timeout_budget(self, monkeypatch):
+        def responder(name, q):
+            return None  # black hole
+        srv = FakeDnsServer(responder)
+        monkeypatch.setattr(dnsresolver, "TOTAL_BUDGET_S", 1.0)
+        monkeypatch.setattr(dnsresolver, "TRY_TIMEOUT_S", 0.3)
+        try:
+            t0 = time.monotonic()
+            assert DnsResolver([srv.addr]).resolve("slow.test") is None
+            assert time.monotonic() - t0 < 3.0
+        finally:
+            srv.stop()
+
+    def test_iterative_referral_walk(self):
+        """root-style server refers to the authority (NS + glue A);
+        the walk follows and gets the answer — Dns.cpp's descent."""
+        auth_holder = {}
+
+        def auth_responder(name, q):
+            return _response(q, answers=[
+                _rr(name, QTYPE_A, 120,
+                    socket.inet_aton("10.77.0.1"))])
+        auth = FakeDnsServer(auth_responder)
+        auth_ip_port = socket.inet_aton("127.0.0.1")
+
+        def root_responder(name, q):
+            return _response(
+                q,
+                authority=[_rr("test", QTYPE_NS, 120,
+                               _name_bytes("ns1.test"))],
+                additional=[_rr("ns1.test", QTYPE_A, 120,
+                                auth_ip_port)])
+        root = FakeDnsServer(root_responder)
+        try:
+            r = DnsResolver([root.addr], iterative=True,
+                            port=auth.port)
+            # referral glue carries 127.0.0.1; the resolver's port
+            # default routes the follow-up to the authority server
+            assert r.resolve("www.deep.test") == "10.77.0.1"
+            assert root.queries and auth.queries
+        finally:
+            root.stop()
+            auth.stop()
+
+
+# --------------------------------------------------------------- proxies
+
+
+class TestProxyPool:
+    def test_sticky_and_ban_rotation(self):
+        pool = ProxyPool(["p1:1", "p2:2", "p3:3"])
+        first = pool.pick("1.2.3.4")
+        pool.release(first)
+        again = pool.pick("1.2.3.4")
+        pool.release(again)
+        assert first == again  # sticky per target ip
+        assert pool.report(first, "1.2.3.4", 403)  # ban
+        nxt = pool.pick("1.2.3.4")
+        pool.release(nxt)
+        assert nxt != first
+        # other target ips still use the banned proxy
+        others = {pool.pick(f"9.9.9.{i}") for i in range(12)}
+        assert first in others
+
+    def test_all_banned_goes_direct(self):
+        pool = ProxyPool(["p1:1", "p2:2"])
+        pool.report("p1:1", "5.5.5.5", 429)
+        pool.report("p2:2", "5.5.5.5", 403)
+        assert pool.pick("5.5.5.5") is None
+
+    def test_ban_page_detection(self):
+        assert looks_banned(403, "")
+        assert looks_banned(429, "")
+        assert looks_banned(200, "<html>Please solve this CAPTCHA")
+        assert not looks_banned(200, "a perfectly fine page " * 20)
+        assert not looks_banned(200,
+                                "long article mentioning captcha "
+                                + "filler " * 2000)
+
+    def test_fetcher_rotates_on_ban(self):
+        hits = {"ban": 0, "good": 0}
+
+        class _Proxy(BaseHTTPRequestHandler):
+            banned = False
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.banned:
+                    hits["ban"] += 1
+                    body = b"Access Denied - CAPTCHA required"
+                else:
+                    hits["good"] += 1
+                    body = (b"<html><title>ok</title>"
+                            b"<body>proxied page body</body></html>")
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        class _Banned(_Proxy):
+            banned = True
+
+        s_ban = ThreadingHTTPServer(("127.0.0.1", 0), _Banned)
+        s_ok = ThreadingHTTPServer(("127.0.0.1", 0), _Proxy)
+        for s in (s_ban, s_ok):
+            threading.Thread(target=s.serve_forever,
+                             daemon=True).start()
+        from open_source_search_engine_tpu.utils import ipresolve
+        ipresolve.resolver_override = lambda host: "10.0.0.1"
+        try:
+            # hash-sticky pick may start on either proxy; the banned
+            # one must be detected and rotated away from
+            pool = ProxyPool([f"127.0.0.1:{s_ban.server_address[1]}",
+                              f"127.0.0.1:{s_ok.server_address[1]}"])
+            f = Fetcher(respect_robots=False, cache_ttl_s=0,
+                        proxies=pool)
+            res = f.fetch_one("http://proxied.test/page")
+            assert res.ok and "proxied page body" in res.content
+            assert hits["good"] >= 1
+        finally:
+            ipresolve.resolver_override = None
+            ipresolve.clear_cache()
+            s_ban.shutdown()
+            s_ok.shutdown()
+
+
+# --------------------------------------------------------------- convert
+
+
+def _tiny_pdf(text: str) -> bytes:
+    stream = f"BT /F1 12 Tf ({text}) Tj ET".encode()
+    return (b"%PDF-1.4\n1 0 obj\n<< /Length " +
+            str(len(stream)).encode() + b" >>\nstream\n" + stream +
+            b"\nendstream\nendobj\ntrailer\n<<>>\n%%EOF\n")
+
+
+class TestConverters:
+    def test_kind_detection(self):
+        assert is_convertible("application/pdf")
+        assert is_convertible("", "http://x.test/a/b.PDF")
+        assert is_convertible("application/msword")
+        assert not is_convertible("text/html")
+
+    def test_builtin_pdf_extraction(self):
+        pdf = _tiny_pdf("quarterly aardwolf report 2021")
+        assert "quarterly aardwolf report 2021" in pdf_text_builtin(pdf)
+
+    def test_builtin_pdf_flate_and_escapes(self):
+        import zlib
+        raw = (rb"BT (line \(one\)) Tj T* (line two) Tj ET")
+        comp = zlib.compress(raw)
+        pdf = (b"%PDF-1.4\n1 0 obj\n<< /Filter /FlateDecode /Length " +
+               str(len(comp)).encode() + b" >>\nstream\n" + comp +
+               b"\nendstream\nendobj\n%%EOF\n")
+        out = pdf_text_builtin(pdf)
+        assert "line (one)" in out and "line two" in out
+
+    def test_convert_to_text_pdf(self):
+        pdf = _tiny_pdf("wombat migration study")
+        assert "wombat migration study" in convert_to_text(
+            pdf, "application/pdf")
+
+    def test_crawl_ingests_pdf(self, tmp_path):
+        """End-to-end: the spider fetches a PDF url, the converter
+        plane turns it into text, and the doc becomes searchable."""
+        from open_source_search_engine_tpu.index.collection import \
+            Collection
+        from open_source_search_engine_tpu.query import engine
+        from open_source_search_engine_tpu.spider import (SpiderLoop,
+                                                          SpiderScheduler)
+
+        pdf = _tiny_pdf("subterranean wombat census results")
+
+        class _Site(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == "/robots.txt":
+                    body, ctype = b"", "text/plain"
+                elif self.path == "/report.pdf":
+                    body, ctype = pdf, "application/pdf"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Site)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        try:
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+            coll = Collection("c", str(tmp_path))
+            sched = SpiderScheduler()
+            sched.add_url(f"{base}/report.pdf")
+            loop = SpiderLoop(coll, sched,
+                              fetcher=Fetcher(cache_ttl_s=0))
+            loop.crawl_step()
+            res = engine.search(coll, "wombat census", topk=5)
+            assert res.total_matches == 1
+            assert res.results[0].url.endswith("/report.pdf")
+        finally:
+            httpd.shutdown()
