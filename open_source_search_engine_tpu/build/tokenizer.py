@@ -301,7 +301,11 @@ def tokenize_html(html: str, url: str | None = None) -> TokenizedDoc:
     HASHGROUP_INURL (reference hashes the url into its own group,
     ``XmlDoc.cpp`` ``hashUrl``). Dispatches to the native C++ core when
     available (bit-identical for ASCII documents; the Python
-    HTMLParser path remains the fallback and the reference semantics)."""
+    HTMLParser path remains the fallback and the reference semantics).
+    Input is NFC-normalized first (UCNormalizer.cpp role) so composed
+    and decomposed forms index as one term on BOTH paths."""
+    from ..utils.unicodenorm import nfc
+    html = nfc(html)
     doc = _native_tdoc(html, url, True)
     if doc is not None:
         return doc
@@ -323,6 +327,8 @@ def tokenize_html(html: str, url: str | None = None) -> TokenizedDoc:
 def tokenize_text(text: str, hashgroup: int = HASHGROUP_BODY) -> TokenizedDoc:
     """Tokenize plain text (injection of non-HTML content; reference doc
     converters produce plain text fed through the same path)."""
+    from ..utils.unicodenorm import nfc
+    text = nfc(text)
     if hashgroup == HASHGROUP_BODY:
         doc = _native_tdoc(text, None, False)
         if doc is not None:

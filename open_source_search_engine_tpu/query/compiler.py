@@ -88,7 +88,7 @@ MAX_GROUP_SUBLISTS = 16
 MAX_BOOL_TERMS = 10
 
 #: synonym conjugates attached per word (Synonyms.cpp caps too)
-MAX_SYNONYMS = 3
+MAX_SYNONYMS = 4
 
 
 @dataclass
@@ -133,19 +133,32 @@ class TermGroup:
         live = [s for s, p in zip(subs, present) if p]
         if len(live) <= 1:
             return [(0, max_positions if p else 0) for p in present]
-        n_var = sum(1 for s, p in zip(subs, present)
-                    if p and s.kind != SUB_ORIGINAL)
         any_prim = any(p and s.kind == SUB_ORIGINAL
                        for s, p in zip(subs, present))
         prim = max(max_positions // 2, 1) if any_prim else 0
-        var = max((max_positions - prim) // max(n_var, 1), 1)
+        # variant quotas stay QUARTER-ALIGNED (P//4): the direct-cube
+        # kernel requires quarter-aligned (base, quota) to assemble
+        # group planes from resident quarter-rows — a 2-slot variant
+        # would silently disqualify common synonym-bearing queries from
+        # the FD fast path. Variants past the slot budget get quota 0
+        # (the reference's mini-merge buffers cap sublists the same
+        # way, MAX_SUBLISTS); conjugates attach before dictionary
+        # synonyms, so the morphological forms win the slots.
+        var = max(max_positions // 4, 1)
+        budget = max_positions - prim
         out = []
         base = 0
         for s, p in zip(subs, present):
             if not p:
                 out.append((min(base, max_positions - 1), 0))
                 continue
-            q = prim if s.kind == SUB_ORIGINAL else var
+            if s.kind == SUB_ORIGINAL:
+                q = prim
+            elif budget >= var:
+                q = var
+                budget -= var
+            else:
+                q = 0
             out.append((min(base, max_positions - 1), q))
             base += q
         return out
@@ -201,6 +214,8 @@ def compile_query(q: str, lang: int = 0,
                   bigrams: bool = True,
                   synonyms: bool = True) -> QueryPlan:
     """Compile a query string into a :class:`QueryPlan`."""
+    from ..utils.unicodenorm import nfc
+    q = nfc(q)  # match the indexed (NFC) term forms
     if _BOOL_RE.search(q):
         try:
             return _compile_boolean(q, lang, synonyms)
@@ -318,6 +333,22 @@ def _conjugates(w: str) -> list[str]:
         if w.endswith("y") and len(w) > 3:
             add(w[:-1] + "ies")
         add(w + "s")
+        # gerund forms (run→running, make→making, walk→walking):
+        # absent junk variants cost nothing (the present mask zeroes
+        # their slot quota) — but for SHORT CVC words the non-doubled
+        # form is a DIFFERENT word's e-drop gerund (car→caring is
+        # "care", hat→hating is "hate"), a real indexed term, so only
+        # the doubled form is emitted there
+        if len(w) > 2 and not w.endswith("ing"):
+            if w.endswith("e"):
+                add(w[:-1] + "ing")
+            elif w[-1] not in "aeiouy" and w[-2] in "aeiou" \
+                    and w[-3] not in "aeiou":
+                add(w + w[-1] + "ing")  # CVC doubling
+                if len(w) > 4:
+                    add(w + "ing")      # visiting-style (no doubling)
+            else:
+                add(w + "ing")
     if w.endswith("ing") and len(w) > 5:
         base = w[:-3]
         if len(base) > 2 and base[-1] == base[-2]:
@@ -334,13 +365,50 @@ def _conjugates(w: str) -> list[str]:
     return out[:MAX_SYNONYMS]
 
 
+_SYN_DICT: dict[str, list[str]] | None = None
+
+#: dictionary synonyms attached per word (on top of conjugates) — the
+#: slot plan must keep room for the primary's half budget
+MAX_DICT_SYNONYMS = 2
+
+
+def _syn_dict() -> dict[str, list[str]]:
+    """word → synonym list from data/synonyms.txt (the Synonyms.cpp /
+    mysynonyms.txt dictionary — any wordlist dropped into the data
+    file extends it; Wiktionary-scale lists are a data problem, the
+    machinery here is the same)."""
+    global _SYN_DICT
+    if _SYN_DICT is None:
+        from pathlib import Path
+        d: dict[str, list[str]] = {}
+        p = Path(__file__).parent / "data" / "synonyms.txt"
+        try:
+            for line in p.read_text(encoding="utf-8").splitlines():
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                ws = [w.strip().lower() for w in line.split(",")
+                      if w.strip()]
+                for w in ws:
+                    lst = d.setdefault(w, [])
+                    lst.extend(x for x in ws if x != w and x not in lst)
+        except OSError:
+            pass
+        _SYN_DICT = d
+    return _SYN_DICT
+
+
 def _word_group(word: str, qpos: int, neg: bool,
                 synonyms: bool = True) -> TermGroup:
     subs = [Sublist(ghash.term_id(word), SUB_ORIGINAL, word)]
     if synonyms and not neg:
         # negatives stay literal: "-apple" must not exclude "apples"
+        variants = list(_conjugates(word))
+        for s in _syn_dict().get(word.lower(), [])[:MAX_DICT_SYNONYMS]:
+            if s not in variants and s != word:
+                variants.append(s)
         subs += [Sublist(ghash.term_id(c), SUB_SYNONYM, c)
-                 for c in _conjugates(word)]
+                 for c in variants]
     return TermGroup(display=word, sublists=subs, negative=neg, qpos=qpos)
 
 

@@ -1220,6 +1220,11 @@ class DeviceIndex:
         if (len(prows) > FD_SCATTER_MAX_ROWS
                 or sum(p[1] for p in prows) > FD_SCATTER_MAX_LANES):
             direct_ok = False
+        # ... and the group bucket capped at 8: the fused-path HBM
+        # budget (_fd_bmax) and the [T,P,D] tail cube both size for
+        # T ≤ 8; rare wider conjunctions grind through the generic F2
+        if len(qplan.groups) > 8:
+            direct_ok = False
         if qplan.bool_table is not None:
             # a boolean query is servable iff SOME satisfying presence
             # assignment uses only groups that have postings; the match
@@ -1413,6 +1418,8 @@ class DeviceIndex:
             def _lp_of(i):
                 p = plans[i]
                 ml = int(p.p_len.max()) if len(p.p_len) else 0
+                if ml == 0:
+                    return 0  # pure quarter-row wave: no tail cube
                 return 512 if ml <= 512 else (
                     F2_LPOST_FLOOR if ml <= F2_LPOST_FLOOR
                     else F2_SCATTER_MAX)
@@ -1425,7 +1432,7 @@ class DeviceIndex:
             for i in fd:
                 fd_parts.setdefault((_lp_of(i), spec_of(i)),
                                     []).append(i)
-            fd_step = max(4, min(16, self._f2_bmax()))
+            fd_step = self._fd_bmax()
             for _, idxs in sorted(fd_parts.items(),
                                   key=lambda kv: str(kv[0])):
                 for a in range(0, len(idxs), fd_step):
@@ -1559,6 +1566,7 @@ class DeviceIndex:
                                         kap32, kap32))
         # B > 4 buckets exist only when the HBM budget allows them
         nb_big = (1, 5) if self._f2_bmax() > 4 else (1,)
+        nb_fd = (1, 5) if self._fd_bmax() > 4 else (1,)
         for n_sel in (2048, 8192):  # F2 base + first escalation rung
             for np_rows in (1, 9):
                 for nb in nb_big:  # B = 4 and (budget allowing) B = bmax
@@ -1576,6 +1584,10 @@ class DeviceIndex:
         pd = dummy()
         pd.g_quarter = np.zeros((T, 4), np.int32)
         pd.g_qsyn = np.zeros((T, 4), np.uint32)
+        pd0 = dummy()  # no-tail variant (pure quarter-row waves)
+        pd0.g_quarter = np.zeros((T, 4), np.int32)
+        pd0.g_qsyn = np.zeros((T, 4), np.uint32)
+        pd0.p_len[:] = 0
         pt = dummy(np_rows=5)  # Rp=8 bucket
         pt.g_quarter = np.zeros((T, 4), np.int32)
         pt.g_qsyn = np.zeros((T, 4), np.uint32)
@@ -1591,9 +1603,11 @@ class DeviceIndex:
         # bigram scatter tails — one unwarmed hit cost a 91 s compile
         # inside a measured pass)
         for n_sel in (2048, 8192):
-            for nb in nb_big:
+            for nb in nb_fd:
                 outs.append(self._run_batch_fd(
                     [pd] * nb, k2, min(n_sel, self.D_cap)))
+                outs.append(self._run_batch_fd(
+                    [pd0] * nb, k2, min(n_sel, self.D_cap)))
                 if n_sel == 2048:
                     outs.append(self._run_batch_fd(
                         [pt] * nb, k2, min(n_sel, self.D_cap)))
@@ -1667,6 +1681,17 @@ class DeviceIndex:
         RTT is ~100 ms, so doubling B nearly halves F2 wall time)."""
         per_q = 48 * MAX_POSITIONS * self.D_cap
         return max(4, min(16, (1536 << 20) // max(per_q, 1)))
+
+    def _fd_bmax(self) -> int:
+        """FD batch cap. The fused Pallas route never materializes the
+        per-query cube — its only [T,P,D]-scale HBM is the posting-tail
+        scatter target — so it batches ~4× deeper than the generic F2
+        envelope at big D (T ≤ 8 worst case)."""
+        from .pallas_scores import use_fused
+        if use_fused(self.D_cap):
+            per_q = 8 * MAX_POSITIONS * self.D_cap * 4
+            return max(4, min(16, (4 << 30) // max(per_q, 1)))
+        return max(4, min(16, self._f2_bmax()))
 
     def _run_batch(self, plans: list[ResidentPlan], kappa: int, k2: int):
         # pinned bucket ladders — every (Rd, Rs, κ, B) combination that
@@ -1821,10 +1846,7 @@ class DeviceIndex:
         of the resident cube, small ones ride a bounded scatter tail —
         no per-query cube assembly."""
         T = max(len(p.required) for p in plans)
-        # FD intermediates are ~48·P·D bytes/query (same envelope as
-        # F2's cube+scoring chain) — cap B by the same HBM budget
-        B = 4 if len(plans) <= 4 else max(min(16, self._f2_bmax()),
-                                          len(plans))
+        B = 4 if len(plans) <= 4 else max(self._fd_bmax(), len(plans))
         zq = 4 * getattr(self, "cube_zero_slot", 0)
         cs = np.full((B, T, 4), zq, np.int32)
         sy = np.zeros((B, T, 4), np.uint32)
@@ -1833,11 +1855,13 @@ class DeviceIndex:
             sy[b, : len(p.g_qsyn)] = p.g_qsyn
         mrp = max([len(p.p_start) for p in plans] + [1])
         Rp = 4 if mrp <= 4 else _bucket(mrp, 8)
-        maxlen = max([int(p.p_len.max()) if len(p.p_len) else 1
-                      for p in plans] + [1])
-        Lp = 512 if maxlen <= 512 else (
+        maxlen = max([int(p.p_len.max()) if len(p.p_len) else 0
+                      for p in plans] + [0])
+        # Lp = 0: every query in the wave is pure quarter-rows — the
+        # fused kernel then compiles without a tail input at all
+        Lp = 0 if maxlen == 0 else (512 if maxlen <= 512 else (
             F2_LPOST_FLOOR if maxlen <= F2_LPOST_FLOOR
-            else F2_SCATTER_MAX)
+            else F2_SCATTER_MAX))
 
         def pad_plan(p: ResidentPlan | None):
             if p is None:
@@ -2278,6 +2302,17 @@ def _direct_cube(d_cube, d_payload, d_docc, d_siterank,
     big = jnp.float32(9.99e8)
     quarter_rows = d_cube.reshape(Vc * 4, P4 * D)
 
+    from .pallas_scores import fd_scores_fused, use_fused
+    if use_fused(D):
+        return _direct_cube_fused(
+            d_cube, d_payload, d_docc, d_siterank, d_doclang, d_dead,
+            n_docs_total, d_filter, d_sort, g_quarter, g_qsyn,
+            p_start, p_len, p_group, p_base, p_quota, p_syn, p_isbase,
+            freqw, required, negative, scored, counts, table, qlang,
+            n_positions=n_positions, lpost=lpost, k2=k2, n_sel=n_sel,
+            use_table=use_table, use_filter=use_filter,
+            use_sort=use_sort)
+
     def one(g_quarter, g_qsyn, p_start, p_len, p_group, p_base,
             p_quota, p_syn, p_isbase, freqw, required, negative,
             scored, counts, table, qlang):
@@ -2347,3 +2382,106 @@ def _direct_cube(d_cube, d_payload, d_docc, d_siterank,
                          p_base, p_quota, p_syn, p_isbase, freqw,
                          required, negative, scored, counts, table,
                          qlang)
+
+
+def _direct_cube_fused(d_cube, d_payload, d_docc, d_siterank,
+                       d_doclang, d_dead, n_docs_total, d_filter,
+                       d_sort, g_quarter, g_qsyn,
+                       p_start, p_len, p_group, p_base, p_quota,
+                       p_syn, p_isbase,
+                       freqw, required, negative, scored, counts,
+                       table, qlang,
+                       n_positions: int, lpost: int, k2: int,
+                       n_sel: int, use_table: bool, use_filter: bool,
+                       use_sort: bool):
+    """FD via the fused Pallas kernel: the per-query [T, P, D] cube
+    never materializes in HBM — only the (usually small) posting TAIL
+    is scattered in XLA; assembly of the resident quarter-rows and the
+    whole scoring chain run tile-by-tile in VMEM
+    (pallas_scores.fd_scores_fused). Same outputs as _direct_cube."""
+    from .pallas_scores import fd_scores_fused
+
+    D = d_dead.shape[0]
+    P = n_positions
+    N = d_payload.shape[0]
+    B, T, _ = g_quarter.shape
+    big = jnp.float32(9.99e8)
+
+    # ---- XLA: per-query tail cubes (zeros when the query has none);
+    # dead-masking for base tail postings happens HERE, so the kernel
+    # only applies the dead mask to the resident quarters ----
+    def tail_of(p_start, p_len, p_quota, p_group, p_base, p_syn,
+                p_isbase):
+        lane = jnp.arange(lpost, dtype=jnp.int32)
+        idx = p_start[:, None] + lane[None, :]
+        m = lane[None, :] < p_len[:, None]
+        idxc = jnp.clip(idx, 0, N - 1)
+        docc = d_docc[idxc]
+        doc = (docc >> jnp.uint32(_OCC_BITS)).astype(jnp.int32)
+        occ = (docc & jnp.uint32(_OCC_MASK)).astype(jnp.int32)
+        pay = (d_payload[idxc]
+               | (p_syn[:, None].astype(jnp.uint32) << jnp.uint32(31)))
+        dead_l = d_dead[jnp.clip(doc, 0, D - 1)]
+        ok = (m & (occ < p_quota[:, None])
+              & ~(dead_l & p_isbase[:, None]))
+        slot = p_base[:, None] + occ
+        tgt = jnp.where(ok, (p_group[:, None] * P + slot) * D + doc,
+                        T * P * D)
+        return jnp.zeros((T * P * D,), jnp.uint32).at[tgt.ravel()].add(
+            jnp.where(ok, pay, jnp.uint32(0)).ravel(), mode="drop"
+        ).reshape(T, P, D)
+
+    from .pallas_scores import fd_scores_fused_notail
+    interp = jax.default_backend() == "cpu"
+    if lpost == 0:
+        # pure quarter-row wave: no tail cube at all
+        ms, presbits = fd_scores_fused_notail(
+            g_quarter.reshape(B, T * 4),
+            g_qsyn.reshape(B, T * 4).astype(jnp.int32),
+            d_cube, d_dead.astype(jnp.int32).reshape(1, D),
+            freqw, counts.astype(jnp.float32), T=T, P=P,
+            interpret=interp)
+    else:
+        tails = jax.vmap(tail_of)(p_start, p_len, p_quota, p_group,
+                                  p_base, p_syn, p_isbase)
+        ms, presbits = fd_scores_fused(
+            g_quarter.reshape(B, T * 4),
+            g_qsyn.reshape(B, T * 4).astype(jnp.int32),
+            d_cube, tails, d_dead.astype(jnp.int32).reshape(1, D),
+            freqw, counts.astype(jnp.float32), T=T, P=P,
+            interpret=interp)
+
+    # ---- XLA tail: match gates + selection (cheap [T, D]/[D] work) --
+    def finish(ms, bits, freqw, required, negative, counts, table,
+               qlang):
+        t_ax = jnp.arange(T, dtype=jnp.int32)
+        present = ((bits[None, :] >> t_ax[:, None]) & 1) > 0  # [T, D]
+        req_ok = jnp.all(jnp.where(required[:, None], present, True),
+                         axis=0)
+        neg_ok = ~jnp.any(jnp.where(negative[:, None], present,
+                                    False), axis=0)
+        tok = presence_table_ok(present, table) if use_table else True
+        match = (req_ok & neg_ok & tok
+                 & (jnp.arange(D) < n_docs_total) & (ms < big))
+        if use_filter:
+            match = match & d_filter
+        if use_sort:
+            final = jnp.where(match, d_sort, 0.0)
+        else:
+            final = jnp.where(
+                match, ms * final_multipliers(d_siterank, d_doclang,
+                                              qlang), 0.0)
+        nm = jnp.sum(match)
+        w_vals, w_idx, missed = _block_topn(final, min(n_sel, D))
+        ts, tl = jax.lax.top_k(w_vals, min(k2, n_sel, D))
+        ti = w_idx[tl]
+        return jnp.concatenate([
+            jnp.atleast_1d(nm.astype(jnp.uint32)),
+            jax.lax.bitcast_convert_type(jnp.atleast_1d(missed),
+                                         jnp.uint32),
+            ti.astype(jnp.uint32),
+            jax.lax.bitcast_convert_type(ts, jnp.uint32),
+        ])
+
+    return jax.vmap(finish)(ms, presbits, freqw, required, negative,
+                            counts, table, qlang)

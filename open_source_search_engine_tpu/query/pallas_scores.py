@@ -1,0 +1,346 @@
+"""Fused Pallas scoring kernels — the scoring chain in ONE HBM pass.
+
+Two kernels share one scoring body (the exact ``scorer.min_scores``
+math — payload decode, position weights, single-term top-10 sums, P×P
+pair cross products, the min):
+
+* ``min_scores_fused``: [T, P, D] cube already in HBM → min_score [D].
+  Replaces the ~30-pass XLA lowering for the generic F2 kernel and the
+  host-packed path on corpus-wide doc axes.
+* ``fd_scores_fused``: the direct-cube (FD) route WITHOUT ever
+  materializing the [T, P, D] cube in HBM: a scalar-prefetch grid DMAs
+  each query's T×4 quarter-rows of the RESIDENT cube tile-by-tile into
+  VMEM, ORs in the (XLA-scattered) tail cube and the dead mask, scores
+  the tile on-chip, and writes one f32 + one presence bitmask per doc.
+  The FD assembly chain (gather + synbit + masks, measured ~27 ms/query
+  at 250k docs) and the scoring chain (~30 ms) collapse into a single
+  bandwidth-bound pass.
+
+Float reduction order differs from the jnp path in the last ulp, which
+every consumer tolerates (escalation tolerance 1e-4, bench recall
+floor 1e-6); the jnp path remains the reference semantics and the
+small-cube / CPU path. Validity rides the payloads: zero payload =
+empty slot (the build-side invariant the FD route already relies on).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..index.posdb import HASHGROUP_END, HASHGROUP_INLINKTEXT
+from . import weights
+from .scorer import MAX_PAIR_SPAN, QDIST
+
+#: doc-axis tile width (lane-dim multiple of 128; [P, P, TILE] f32
+#: buffers at 512 are 512 KB — a handful fit VMEM comfortably)
+TILE_D = 512
+
+#: use the fused kernels only where they pay: corpus-wide doc axes.
+#: Small phase-2 cubes (κ ≤ 2048) fuse fine under plain XLA.
+MIN_D = 8192
+
+
+def _sel_chain(idx, table):
+    """Tiny-table lookup as a select chain (same trick as
+    scorer._tiny_lookup — in-register, no gather)."""
+    out = jnp.full(idx.shape, float(table[0]), jnp.float32)
+    for v in range(1, len(table)):
+        out = jnp.where(idx == v, jnp.float32(table[v]), out)
+    return out
+
+
+def _score_tile(cube, fw, cnt, T: int, P: int):
+    """The scoring body on one [T, P, TD] VMEM tile → (min_score [TD],
+    presence bitmask [TD] int32). Bit-for-bit the scorer.min_scores
+    math (modulo reduction order)."""
+    big = jnp.float32(9.99e8)
+
+    valid = cube != 0
+    wordpos = (cube & jnp.uint32(0x3FFFF)).astype(jnp.int32)
+    hg = ((cube >> jnp.uint32(18)) & jnp.uint32(0xF)).astype(jnp.int32)
+    den = ((cube >> jnp.uint32(22)) & jnp.uint32(0x1F)).astype(
+        jnp.int32)
+    spam = ((cube >> jnp.uint32(27)) & jnp.uint32(0xF)).astype(
+        jnp.int32)
+    syn = ((cube >> jnp.uint32(31)) & jnp.uint32(1)).astype(jnp.int32)
+    hgw = _sel_chain(hg, weights.HASH_GROUP_WEIGHTS)
+    denw = jnp.minimum(
+        jnp.float32(0.35) * jnp.exp(den.astype(jnp.float32)
+                                    * jnp.float32(np.log(1.03445))),
+        1.0)
+    spamf = spam.astype(jnp.float32)
+    spamw = jnp.where(hg == HASHGROUP_INLINKTEXT,
+                      jnp.sqrt(1.0 + spamf),
+                      (spamf + 1.0) * jnp.float32(1.0 / 16.0))
+    synw = jnp.where(syn == 1, jnp.float32(weights.SYNONYM_WEIGHT),
+                     jnp.float32(1.0))
+    posw = hgw * denw * spamw * synw                      # [T, P, TD]
+    posscore = (jnp.float32(weights.BASE_SCORE) * posw * posw
+                * valid.astype(jnp.float32))
+    present = jnp.any(valid, axis=1)                      # [T, TD]
+
+    # singles: top-MAX_TOP over {mapped-hashgroup maxima} ∪ {inlink
+    # occurrences} (getSingleTermScore)
+    mhg = _sel_chain(hg, weights.MAPPED_HASHGROUP).astype(jnp.int32)
+    is_inlink = hg == HASHGROUP_INLINKTEXT
+    cands = []
+    for g in range(HASHGROUP_END):
+        if g == HASHGROUP_INLINKTEXT:
+            cands.append(jnp.zeros((T, cube.shape[2]), jnp.float32))
+        else:
+            cands.append(jnp.max(
+                jnp.where(mhg == g, posscore, 0.0), axis=1))
+    for p in range(P):
+        cands.append(jnp.where(is_inlink[:, p], posscore[:, p], 0.0))
+    cand = jnp.stack(cands, axis=1)               # [T, G+P, TD]
+    k10 = min(weights.MAX_TOP, cand.shape[1])
+    iota_c = jax.lax.broadcasted_iota(jnp.int32, cand.shape, 1)
+    top_sum = jnp.zeros((T, cube.shape[2]), jnp.float32)
+    work = cand
+    for _ in range(k10):
+        m = jnp.max(work, axis=1)
+        top_sum = top_sum + m
+        am = jnp.argmax(work, axis=1)
+        work = jnp.where(iota_c == am[:, None, :],
+                         jnp.float32(-1.0), work)
+    single = top_sum * (fw * fw)[:, None]         # [T, TD]
+
+    # expand dims on the f32 BEFORE comparing: Mosaic cannot reshape
+    # sub-32-bit (i1) vectors along the minor dim
+    s_mask = present & (cnt[:, None] > 0.5)
+    min_single = jnp.min(jnp.where(s_mask, single, big), axis=0)
+
+    # pairs: exact max over P×P per nearby (i, j) (pair_best)
+    in_body = _sel_chain(hg, weights.IN_BODY) > 0.5       # [T, P, TD]
+    min_pair = jnp.full(min_single.shape, big)
+    any_pair = jnp.zeros(min_single.shape, jnp.bool_)
+    for i in range(T):
+        for j in range(i + 1, min(i + 1 + MAX_PAIR_SPAN, T)):
+            delta = (wordpos[j][None, :, :]
+                     - wordpos[i][:, None, :]).astype(jnp.float32)
+            d_plain = jnp.maximum(jnp.abs(delta), 2.0)    # [P, P, TD]
+            bi = in_body[i][:, None, :]
+            bj = in_body[j][None, :, :]
+            mixed = bi != bj
+            both_nb = (~bi) & (~bj)
+            d_base = jnp.where(
+                both_nb & (d_plain > weights.NONBODY_DIST_CAP),
+                jnp.float32(weights.FIXED_DISTANCE), d_plain)
+            d_adj = (jnp.where(d_base >= QDIST, d_base - QDIST, d_base)
+                     + (delta < 0))
+            dist = jnp.where(mixed,
+                             jnp.float32(weights.FIXED_DISTANCE),
+                             d_adj)
+            pvij = (valid[i][:, None, :] & valid[j][None, :, :])
+            ps = (jnp.float32(weights.BASE_SCORE)
+                  * posw[i][:, None, :] * posw[j][None, :, :]
+                  / (dist + 1.0)) * pvij
+            best = jnp.max(ps, axis=(0, 1))               # [TD]
+            wts = best * fw[i] * fw[j]
+            pair_ok = (present[i] & present[j]
+                       & (cnt[i] > 0.5) & (cnt[j] > 0.5))
+            min_pair = jnp.where(pair_ok,
+                                 jnp.minimum(min_pair, wts), min_pair)
+            any_pair = any_pair | pair_ok
+
+    ms = jnp.minimum(jnp.where(any_pair, min_pair, big), min_single)
+    ms = jnp.where(jnp.any(s_mask, axis=0), ms, jnp.float32(1.0))
+    # presence bitmask (T ≤ 16 bits): callers unpack for req/neg/table
+    pres = jnp.zeros(ms.shape, jnp.int32)
+    for t in range(T):
+        pres = pres | (present[t].astype(jnp.int32) << t)
+    return ms, pres
+
+
+# --------------------------------------------------------------- F2 path
+
+def _ms_kernel(cube_ref, fw_ref, cnt_ref, out_ref, *, T: int, P: int):
+    ms, _ = _score_tile(cube_ref[0], fw_ref[0], cnt_ref[0], T, P)
+    out_ref[0] = ms
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def min_scores_fused(cube, freqw, counts, interpret: bool = False):
+    """[T, P, D] uint32 cube → min_score [D] f32 (validity = payload
+    ≠ 0). ``counts`` bool [T]. Batched callers vmap this; pallas lifts
+    the batch axis into the grid."""
+    from jax.experimental import pallas as pl
+
+    T, P, D = cube.shape
+    assert D % TILE_D == 0, (T, P, D)
+    fw = freqw.astype(jnp.float32).reshape(1, T)
+    cnt = counts.astype(jnp.float32).reshape(1, T)
+    cube4 = cube.reshape(1, T, P, D)
+    out = pl.pallas_call(
+        functools.partial(_ms_kernel, T=T, P=P),
+        grid=(D // TILE_D,),
+        in_specs=[
+            pl.BlockSpec((1, T, P, TILE_D),
+                         lambda d: (0, 0, 0, d)),
+            pl.BlockSpec((1, T), lambda d: (0, 0)),
+            pl.BlockSpec((1, T), lambda d: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, TILE_D), lambda d: (0, d)),
+        out_shape=jax.ShapeDtypeStruct((1, D), jnp.float32),
+        interpret=interpret,
+    )(cube4, fw, cnt)
+    return out[0]
+
+
+# --------------------------------------------------------------- FD path
+
+def _fd_kernel(gq_ref, syn_ref, row_ref, *rest, T: int, P: int,
+               has_tail: bool):
+    """Grid (B, D/TILE, T·4): accumulate one quarter-row slice per
+    step into the VMEM cube tile; score on the last quarter. Waves
+    whose every query is pure quarter-rows (no posting tail — the
+    common FD case) compile WITHOUT the tail input, skipping a
+    cube-sized HBM write+read per query."""
+    from jax.experimental import pallas as pl
+
+    if has_tail:
+        tail_ref, dead_ref, fw_ref, cnt_ref, ms_ref, pres_ref, \
+            acc_ref = rest
+    else:
+        dead_ref, fw_ref, cnt_ref, ms_ref, pres_ref, acc_ref = rest
+
+    b = pl.program_id(0)
+    tq = pl.program_id(2)
+    P4 = P // 4
+
+    @pl.when(tq == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    row = row_ref[0]                                # [P4, TILE] u32
+    synbit = (syn_ref[b, tq].astype(jnp.uint32) << jnp.uint32(31))
+    # scratch is [T·4, P4, TILE]: a whole-row store at a dynamic tq
+    # keeps the sublane dim full (Mosaic requires sublane offsets to
+    # be 8-aligned; q·P4 is not). The row-major regrouping
+    # [T·4, P4] → [T, 4·P4] below lands quarter q of term t exactly at
+    # positions q·P4.. — the g_quarter layout.
+    acc_ref[pl.dslice(tq, 1), :, :] = \
+        jnp.where(row != 0, row | synbit, row)[None]
+
+    @pl.when(tq == pl.num_programs(2) - 1)
+    def _score():
+        live = dead_ref[0] == 0                     # [TILE]
+        cube = jnp.where(live[None, None, :],
+                         acc_ref[...].reshape(T, P, acc_ref.shape[2]),
+                         jnp.uint32(0))
+        if has_tail:
+            # tail postings were dead-filtered at scatter time (delta
+            # postings of re-added docs live PAST the dead mask) — OR
+            # after masking. Slot ranges are disjoint by the slot plan.
+            cube = cube | tail_ref[0]
+        ms, pres = _score_tile(cube, fw_ref[0, 0], cnt_ref[0, 0], T, P)
+        ms_ref[0, 0] = ms
+        pres_ref[0, 0] = pres
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("T", "P", "interpret"))
+def fd_scores_fused(g_quarter, g_qsyn, d_cube, tail_cube, dead_i32,
+                    freqw, counts, T: int, P: int,
+                    interpret: bool = False):
+    """Tail-carrying variant (see _fd_kernel)."""
+    return _fd_call(g_quarter, g_qsyn, d_cube, tail_cube, dead_i32,
+                    freqw, counts, T=T, P=P, interpret=interpret,
+                    has_tail=True)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("T", "P", "interpret"))
+def fd_scores_fused_notail(g_quarter, g_qsyn, d_cube, dead_i32,
+                           freqw, counts, T: int, P: int,
+                           interpret: bool = False):
+    """No-tail variant: pure quarter-row waves."""
+    return _fd_call(g_quarter, g_qsyn, d_cube, None, dead_i32,
+                    freqw, counts, T=T, P=P, interpret=interpret,
+                    has_tail=False)
+
+
+def _fd_call(g_quarter, g_qsyn, d_cube, tail_cube, dead_i32,
+             freqw, counts, T: int, P: int,
+             interpret: bool, has_tail: bool):
+    """The direct-cube route, fused: returns (min_score [B, D] f32,
+    presence bitmask [B, D] int32).
+
+    ``g_quarter``/``g_qsyn`` [B, T·4] int32 — absolute quarter-row
+    indices into the resident cube + per-quarter synonym flags;
+    ``d_cube`` the flat resident cube [Vc·P·D]; ``tail_cube``
+    [B, T, P, D] uint32 — the XLA-scattered posting tail (zeros where
+    the query has none); ``dead_i32`` [1, D]."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, TQ = g_quarter.shape
+    assert TQ == 4 * T
+    D = dead_i32.shape[1]
+    assert D % TILE_D == 0
+    P4 = P // 4
+    Vc4 = d_cube.shape[0] // (P4 * D)
+    rows3 = d_cube.reshape(Vc4, P4, D)
+    # (B, 1, T) so every block dim equals an array dim (Mosaic requires
+    # sublane block dims to match the array or divide 8)
+    fw = freqw.astype(jnp.float32).reshape(B, 1, T)
+    cnt = counts.astype(jnp.float32).reshape(B, 1, T)
+
+    in_specs = [
+        pl.BlockSpec((1, P4, TILE_D),
+                     lambda b, d, tq, gq, syn: (gq[b, tq], 0, d)),
+    ]
+    operands = [rows3]
+    if has_tail:
+        in_specs.append(
+            pl.BlockSpec((1, T, P, TILE_D),
+                         lambda b, d, tq, gq, syn: (b, 0, 0, d)))
+        operands.append(tail_cube)
+    in_specs += [
+        pl.BlockSpec((1, TILE_D),
+                     lambda b, d, tq, gq, syn: (0, d)),
+        pl.BlockSpec((1, 1, T),
+                     lambda b, d, tq, gq, syn: (b, 0, 0)),
+        pl.BlockSpec((1, 1, T),
+                     lambda b, d, tq, gq, syn: (b, 0, 0)),
+    ]
+    operands += [dead_i32, fw, cnt]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,       # g_quarter, g_qsyn
+        grid=(B, D // TILE_D, TQ),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, TILE_D),
+                         lambda b, d, tq, gq, syn: (b, 0, d)),
+            pl.BlockSpec((1, 1, TILE_D),
+                         lambda b, d, tq, gq, syn: (b, 0, d)),
+        ],
+        scratch_shapes=[pltpu.VMEM((T * 4, P // 4, TILE_D),
+                                   jnp.uint32)],
+    )
+    ms, pres = pl.pallas_call(
+        functools.partial(_fd_kernel, T=T, P=P, has_tail=has_tail),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((B, 1, D), jnp.float32),
+                   jax.ShapeDtypeStruct((B, 1, D), jnp.int32)],
+        interpret=interpret,
+    )(g_quarter, g_qsyn, *operands)
+    return ms[:, 0], pres[:, 0]
+
+
+def use_fused(D: int) -> bool:
+    """Route policy: fused kernels on TPU backends for corpus-wide doc
+    axes (OSSE_PALLAS=0 disables; =force enables everywhere, which
+    tests use with interpret mode on CPU)."""
+    mode = os.environ.get("OSSE_PALLAS", "1")
+    if mode == "0":
+        return False
+    if mode == "force":
+        return D % TILE_D == 0
+    return (D >= MIN_D and D % TILE_D == 0
+            and jax.default_backend() not in ("cpu",))

@@ -83,9 +83,11 @@ _META_CHARSET_RE = re.compile(
 def sniff_charset(data: bytes, declared: str | None) -> str:
     """Charset resolution (the iana_charset.cpp role): HTTP header >
     BOM > <meta charset> / http-equiv sniff over the head bytes >
-    utf-8 fallback. Unknown names fall back to utf-8-with-replace at
-    decode time (codecs.lookup gate)."""
-    import codecs
+    utf-8 fallback. Web-reality aliases (x-sjis, ks_c_5601-1987, …)
+    map through utils.unicodenorm.CHARSET_ALIASES; names neither the
+    alias table nor the codec registry know fall back to
+    utf-8-with-replace at decode time."""
+    from ..utils.unicodenorm import resolve_charset
     cand = declared
     if not cand:
         if data[:3] == b"\xef\xbb\xbf":
@@ -96,13 +98,7 @@ def sniff_charset(data: bytes, declared: str | None) -> str:
             m = _META_CHARSET_RE.search(data[:4096])
             if m:
                 cand = m.group(1).decode("ascii", "replace")
-    if cand:
-        try:
-            codecs.lookup(cand)
-            return cand
-        except LookupError:
-            pass
-    return "utf-8"
+    return resolve_charset(cand) or "utf-8"
 
 
 def _gunzip_capped(data: bytes) -> bytes:

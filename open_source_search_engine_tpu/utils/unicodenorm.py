@@ -1,0 +1,61 @@
+"""Unicode normalization — the UCNormalizer.cpp role.
+
+The reference normalizes documents before hashing (``UCNormalizer.cpp``
++ ``ucdata/`` tables) so "é" composed and "e"+combining-acute index as
+one term. Here NFC runs at the tokenizer/query seam: both the native
+(C++) and Python tokenizers receive ALREADY-normalized text, so their
+outputs stay identical and query terms match indexed terms regardless
+of the source encoding's composition habits.
+
+``nfc`` is a thin, fast-path wrapper: ASCII text (the overwhelming
+majority byte-wise) skips the normalizer entirely via str.isascii —
+a C-speed scan.
+"""
+
+from __future__ import annotations
+
+import unicodedata
+
+
+def nfc(text: str) -> str:
+    if not text or text.isascii():
+        return text
+    return unicodedata.normalize("NFC", text)
+
+
+#: IANA / web-reality charset aliases Python's codecs don't know by
+#: that spelling (iana_charset.cpp maps ~100 of these; Python's codec
+#: registry covers the decoders themselves)
+CHARSET_ALIASES = {
+    "x-sjis": "shift_jis",
+    "x-euc-jp": "euc_jp",
+    "iso-8859-8-i": "iso-8859-8",
+    "unicode-1-1-utf-8": "utf-8",
+    "unicode": "utf-16",
+    "ks_c_5601-1987": "cp949",
+    "ks_c_5601": "cp949",
+    "macintosh": "mac_roman",
+    "x-mac-roman": "mac_roman",
+    "iso-latin-1": "latin-1",
+    "8859-1": "latin-1",
+    "win-1251": "cp1251",
+    "windows-874": "cp874",
+    "x-gbk": "gbk",
+    "gb_2312-80": "gb2312",
+    "ansi": "cp1252",
+    "none": "utf-8",
+}
+
+
+def resolve_charset(name: str | None) -> str | None:
+    """codecs-resolvable encoding name for a declared charset, or
+    None when it is unknown (caller falls back to utf-8+replace)."""
+    import codecs
+    if not name:
+        return None
+    cand = CHARSET_ALIASES.get(name.strip().lower(), name)
+    try:
+        codecs.lookup(cand)
+        return cand
+    except LookupError:
+        return None

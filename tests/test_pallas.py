@@ -1,0 +1,119 @@
+"""Fused Pallas scoring-kernel parity (interpret mode on CPU).
+
+The fused kernels (pallas_scores.py) reimplement the scoring chain
+and the FD assembly; on CPU CI they never run by default (use_fused
+gates them to TPU backends), so these tests FORCE them through
+interpret mode and pin them against the jnp reference path — both at
+the min_scores unit seam and end-to-end through the FD route."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from open_source_search_engine_tpu.query import scorer
+from open_source_search_engine_tpu.query.pallas_scores import (
+    TILE_D, min_scores_fused)
+
+
+def _rand_cube(rng, T, P, D, density=0.25, inlink_frac=0.1):
+    wordpos = rng.integers(0, 200000, (T, P, D)).astype(np.uint32)
+    hg = rng.integers(0, 11, (T, P, D)).astype(np.uint32)
+    # force some inlink-text rows (spamw sqrt path + single-term pool)
+    hg = np.where(rng.random((T, P, D)) < inlink_frac, 5, hg)
+    den = rng.integers(1, 32, (T, P, D)).astype(np.uint32)
+    spam = rng.integers(0, 16, (T, P, D)).astype(np.uint32)
+    syn = rng.integers(0, 2, (T, P, D)).astype(np.uint32)
+    payload = (wordpos | (hg << 18) | (den << 22) | (spam << 27)
+               | (syn << 31))
+    pv = rng.random((T, P, D)) < density
+    cube = np.where(pv, payload, 0).astype(np.uint32)
+    pv = cube != 0  # the build-side invariant the kernel relies on
+    return cube, pv
+
+
+class TestMinScoresFused:
+    @pytest.mark.parametrize("T,seed", [(4, 0), (8, 1)])
+    def test_parity_random_cube(self, T, seed):
+        rng = np.random.default_rng(seed)
+        P, D = 16, TILE_D * 2
+        cube, pv = _rand_cube(rng, T, P, D)
+        fw = (rng.random(T) * 0.5 + 0.2).astype(np.float32)
+        counts = rng.random(T) < 0.7
+        if not counts.any():
+            counts[0] = True
+        ref, _ = scorer.min_scores(jnp.asarray(cube), jnp.asarray(pv),
+                                   jnp.asarray(fw),
+                                   jnp.asarray(counts))
+        pal = min_scores_fused(jnp.asarray(cube), jnp.asarray(fw),
+                               jnp.asarray(counts), interpret=True)
+        np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-7)
+
+    def test_parity_empty_and_degenerate(self):
+        T, P, D = 4, 16, TILE_D
+        cube = np.zeros((T, P, D), np.uint32)
+        fw = np.full(T, 0.5, np.float32)
+        counts = np.ones(T, bool)
+        ref, _ = scorer.min_scores(
+            jnp.asarray(cube), jnp.asarray(cube != 0),
+            jnp.asarray(fw), jnp.asarray(counts))
+        pal = min_scores_fused(jnp.asarray(cube), jnp.asarray(fw),
+                               jnp.asarray(counts), interpret=True)
+        np.testing.assert_allclose(np.asarray(pal), np.asarray(ref))
+
+
+class TestFusedEndToEnd:
+    def test_fd_route_matches_jnp_path(self, tmp_path):
+        """Index a corpus whose common multi-term queries take the FD
+        route, then compare the whole search output with the fused
+        path forced (interpret) vs disabled."""
+        from open_source_search_engine_tpu.build import docproc
+        from open_source_search_engine_tpu.index.collection import \
+            Collection
+        from open_source_search_engine_tpu.parallel.routecheck import \
+            ROUTE_ENV, route_docs
+        from open_source_search_engine_tpu.query import engine
+        import open_source_search_engine_tpu.query.devindex as dv
+
+        saved = {k: os.environ.get(k) for k in
+                 list(ROUTE_ENV) + ["OSSE_PALLAS"]}
+        os.environ.update(ROUTE_ENV)
+        try:
+            coll = Collection("p", str(tmp_path))
+            docproc.index_batch(coll, route_docs(256, "pal"))
+            coll.posdb.dump()
+            coll.titledb.dump()
+            di = engine.get_device_index(coll)
+            queries = ["alpha beta", "alpha gamma", "boxes dogs",
+                       "alpha", "zeta"]
+            outs = {}
+            for flag in ("0", "force"):
+                os.environ["OSSE_PALLAS"] = flag
+                dv._direct_cube.clear_cache()
+                di.route_counts = {"f1": 0, "fd": 0, "f2": 0}
+                res = di.search_batch(queries, topk=8)
+                outs[flag] = res
+                if flag == "force":
+                    assert di.route_counts["fd"] > 0  # FD exercised
+            for q, a, b in zip(queries, outs["0"], outs["force"]):
+                assert a[2] == b[2], q                   # n_matched
+                np.testing.assert_allclose(b[1], a[1], rtol=1e-5,
+                                           err_msg=q)   # scores
+                # docids equal at strictly-untied ranks
+                for r in range(len(a[1])):
+                    tied = ((r > 0 and a[1][r - 1] == a[1][r])
+                            or (r + 1 < len(a[1])
+                                and a[1][r + 1] == a[1][r]))
+                    if not tied:
+                        assert a[0][r] == b[0][r], (q, r)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            dv._direct_cube.clear_cache()

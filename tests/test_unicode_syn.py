@@ -1,0 +1,84 @@
+"""Synonym dictionary + Unicode normalization/charset goldens
+(Synonyms.cpp / UCNormalizer.cpp / iana_charset.cpp roles)."""
+
+import tempfile
+import unicodedata
+
+from open_source_search_engine_tpu.build import docproc
+from open_source_search_engine_tpu.index.collection import Collection
+from open_source_search_engine_tpu.query import engine
+from open_source_search_engine_tpu.query.compiler import compile_query
+from open_source_search_engine_tpu.spider.fetcher import sniff_charset
+from open_source_search_engine_tpu.utils.unicodenorm import (nfc,
+                                                             resolve_charset)
+
+
+class TestSynonymDictionary:
+    def test_dictionary_expansion_in_plan(self):
+        plan = compile_query("car")
+        subs = [s.display for s in plan.groups[0].sublists]
+        assert "automobile" in subs
+
+    def test_synonym_doc_found_and_ranked_below_exact(self, tmp_path):
+        coll = Collection("s", str(tmp_path))
+        docproc.index_document(
+            coll, "http://a.test/exact",
+            "<html><body><p>a shiny red car parked outside the "
+            "office building today</p></body></html>")
+        docproc.index_document(
+            coll, "http://a.test/syn",
+            "<html><body><p>a shiny red automobile parked outside "
+            "the office building today</p></body></html>")
+        res = engine.search(coll, "car", topk=5, site_cluster=False)
+        assert res.total_matches == 2
+        urls = [r.url for r in res.results]
+        assert urls[0].endswith("/exact")   # exact beats synonym
+        assert urls[1].endswith("/syn")     # ×0.90² synonym weight
+
+    def test_conjugates_still_rank(self, tmp_path):
+        coll = Collection("c", str(tmp_path))
+        docproc.index_document(
+            coll, "http://b.test/1",
+            "<html><body><p>she was running through the park at "
+            "dawn</p></body></html>")
+        res = engine.search(coll, "run", topk=5)
+        assert res.total_matches == 1
+
+
+class TestUnicode:
+    def test_nfc_fastpath_ascii(self):
+        s = "plain ascii"
+        assert nfc(s) is s
+
+    def test_nfd_document_matches_nfc_query(self, tmp_path):
+        coll = Collection("u", str(tmp_path))
+        # document arrives DECOMPOSED (e + combining acute)
+        nfd_word = unicodedata.normalize("NFD", "café")
+        assert nfd_word != "café"  # really decomposed
+        docproc.index_document(
+            coll, "http://u.test/1",
+            f"<html><body><p>the {nfd_word} serves espresso "
+            "daily</p></body></html>")
+        # query arrives COMPOSED
+        res = engine.search(coll, "café", topk=5)
+        assert res.total_matches == 1
+
+    def test_latin1_page_decodes_and_indexes(self, tmp_path):
+        raw = "Münchner Straßenfest".encode("latin-1")
+        cs = sniff_charset(raw, "iso-8859-1")
+        text = raw.decode(cs)
+        coll = Collection("l", str(tmp_path))
+        docproc.index_document(
+            coll, "http://l.test/1",
+            f"<html><body><p>{text} beginnt morgen</p></body></html>")
+        res = engine.search(coll, "münchner", topk=5)
+        assert res.total_matches == 1
+
+    def test_charset_aliases(self):
+        assert resolve_charset("x-sjis") == "shift_jis"
+        assert resolve_charset("ks_c_5601-1987") == "cp949"
+        assert resolve_charset("totally-bogus") is None
+        # header charset wins; meta sniff works
+        assert sniff_charset(b"<meta charset='gb2312'>", None) \
+            == "gb2312"
+        assert sniff_charset(b"\xef\xbb\xbfrest", None) == "utf-8"
