@@ -196,12 +196,17 @@ def _parse_date(val) -> float | None:
 
 
 def _tokenize_doc(content: str, url: str, is_html: bool,
-                  fields: dict | None) -> TokenizedDoc:
+                  fields: dict | None = None) -> TokenizedDoc:
     """Structured (JSON) docs tokenize their string field values as the
     searchable text; everything else goes through the HTML/plain
-    tokenizers."""
-    if fields:
-        text = " . ".join(str(v) for v in fields.values()
+    tokenizers. The gate and the text source are ALWAYS re-derived
+    from the content itself: augmented fields (catdb categories, the
+    built-in date — present in every stored titlerec) must never
+    hijack tokenization, and add/tombstone must tokenize identically
+    regardless of which fields dict the caller holds."""
+    jf = extract_fields(content)
+    if jf:
+        text = " . ".join(str(v) for v in jf.values()
                           if isinstance(v, str))
         if text:
             return tokenize_text(text)
@@ -608,6 +613,9 @@ def index_document(coll: Collection, url: str, content: str, *,
     # boilerplate gate (Sections dup votes): sections this page shares
     # with enough sibling pages of the site demote at build time
     flds = extract_fields(content)
+    # directory taxonomy (Catdb): a filed site's docs carry catid/
+    # category fields — gbmin:catid:/gbfacet:category do the rest
+    flds.update(coll.catdb.doc_fields(site))
     tdoc = _tokenize_doc(content, u.full, is_html, flds)
     sect_of = doc_section_hashes(tdoc)
     boiler = coll.sectiondb.boiler_set(site, sect_of.values())
@@ -705,6 +713,7 @@ def index_batch(coll: Collection, docs, *, is_html: bool = True,
     for i, u, url, content, site, sr in work:
         inlinks = coll.linkdb.inlinks_for_url(site, u.full)
         flds = extract_fields(content)
+        flds.update(coll.catdb.doc_fields(site))
         tdoc = _tokenize_doc(content, u.full, is_html, flds)
         sect_of = doc_section_hashes(tdoc)
         boiler = coll.sectiondb.boiler_set(site, sect_of.values())

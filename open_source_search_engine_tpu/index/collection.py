@@ -90,6 +90,8 @@ class Collection:
         self.sectiondb = Sectiondb(self.dir)
         from .fielddb import Fielddb
         self.fielddb = Fielddb(self.dir)
+        from .catdb import Catdb
+        self.catdb = Catdb(self.dir)
         from ..query.speller import Speller
         self.speller = Speller(self.dir)
         self._stats_path = self.dir / "collstats.json"
@@ -113,7 +115,8 @@ class Collection:
                 "clusterdb": self.clusterdb, "linkdb": self.linkdb.rdb,
                 "tagdb": self.tagdb.rdb,
                 "sectiondb": self.sectiondb.rdb,
-                "fielddb": self.fielddb.rdb}
+                "fielddb": self.fielddb.rdb,
+                "catdb": self.catdb.rdb}
 
     # --- stats used by ranking ---
 

@@ -518,8 +518,11 @@ class _HostQueue:
 class ClusterClient:
     """Routes adds/reads/queries across the node processes."""
 
-    def __init__(self, conf: HostsConf, use_heartbeat: bool = True):
+    def __init__(self, conf: HostsConf, use_heartbeat: bool = True,
+                 parms=None):
         self.conf = conf
+        #: optional global Conf (utils.parms) — supplies alert_cmd etc.
+        self.parms = parms
         self.hostmap = HostMap(conf.n_shards, conf.n_replicas)
         self._queues = {(s, r): _HostQueue()
                         for s in range(conf.n_shards)
@@ -570,13 +573,45 @@ class ClusterClient:
             return False
 
     def check_hosts(self) -> None:
-        """One heartbeat sweep over every host."""
+        """One heartbeat sweep over every host. Liveness TRANSITIONS
+        fire the operator alert hook (the reference PingServer emails/
+        SMSes admins on host death, ``PingServer.h:77`` — here a log
+        line plus an optional ``alert_cmd``)."""
         for s in range(self.conf.n_shards):
             for r in range(self.conf.n_replicas):
-                if self._ping(s, r):
+                was = bool(self.hostmap.alive[s, r])
+                now = self._ping(s, r)
+                if now:
                     self.hostmap.mark_alive(s, r)
                 else:
                     self.hostmap.mark_dead(s, r)
+                if was != now:
+                    self._alert("recovered" if now else "dead", s, r)
+
+    def _alert(self, event: str, shard: int, replica: int) -> None:
+        """Operator alert on a liveness transition: always logged; the
+        ``alert_cmd`` parm (or OSSE_ALERT_CMD env) additionally runs a
+        command with the event in its environment — the email/SMS/
+        pager seam without baking in a delivery mechanism."""
+        addr = self.conf.addresses[shard][replica]
+        log.warning("ALERT host %s (shard %d replica %d) %s",
+                    addr, shard, replica, event)
+        cmd = os.environ.get("OSSE_ALERT_CMD", "") or \
+            getattr(self.parms, "alert_cmd", "")
+        if not cmd:
+            return
+        try:
+            import subprocess
+            env = dict(os.environ,
+                       OSSE_ALERT_EVENT=event,
+                       OSSE_ALERT_HOST=addr,
+                       OSSE_ALERT_SHARD=str(shard),
+                       OSSE_ALERT_REPLICA=str(replica))
+            subprocess.Popen(cmd, shell=True, env=env,
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+        except Exception as e:  # noqa: BLE001 — alerting must not kill
+            log.warning("alert_cmd failed: %s", e)
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(HEARTBEAT_INTERVAL_S):

@@ -235,6 +235,13 @@ class SearchHTTPServer:
         #: niceness gate: background requests yield to interactive
         from ..utils.nice import NicenessGate
         self.nice_gate = NicenessGate()
+        #: Msg17/Msg40Cache: rendered result pages, TTL'd (RdbCache
+        #: role via the general TtlCache)
+        from ..utils.ttlcache import TtlCache
+        self._result_cache = TtlCache(ttl_s=30.0, max_entries=2048)
+        #: per-user admin accounts (Users.cpp / users.txt)
+        from ..utils.users import Users
+        self.users = Users(base_dir)
 
     BAN_COOLDOWN_S = 60.0
 
@@ -273,11 +280,24 @@ class SearchHTTPServer:
                 self.colldb.get(cname), queries, topk=topk,
                 offset=offset)
 
-    def _authorized(self, query: dict) -> bool:
-        """Master-password gate for /admin (Conf::m_masterPwds;
-        reference PageLogin). Empty password = open instance."""
+    def _authorized(self, query: dict,
+                    min_role: str = "admin") -> bool:
+        """Auth gate for /admin and mutating endpoints: the master
+        password (Conf::m_masterPwds) OR a per-user credential from
+        the users table (Users.cpp — ``user=``/``upwd=`` params) at
+        the required role. Empty master password AND empty user table
+        = open instance."""
         pwd = self.conf.master_password
-        return (not pwd) or query.get("pwd", "") == pwd
+        has_users = bool(self.users.names())
+        if not pwd and not has_users:
+            return True
+        if pwd and query.get("pwd", "") == pwd:
+            return True
+        u = query.get("user", "")
+        if u and self.users.check(u, query.get("upwd", ""),
+                                  min_role=min_role):
+            return True
+        return False
 
     # --- request handling -------------------------------------------------
 
@@ -423,12 +443,32 @@ class SearchHTTPServer:
         s = min(max(int(query.get("s", 0)), 0), 100000)
         fmt = query.get("format", "json")
         self.stats["queries"] += 1
+        # Msg17/Msg40Cache result cache: identical pages within the TTL
+        # serve from memory. Single-node, the LOCAL index version in
+        # the key invalidates instantly on mutation; the distributed
+        # planes (cluster/sharded) mutate on remote nodes this frontend
+        # can't version-watch, so there staleness is bounded by the TTL
+        # alone (the reference's Msg17 accepts the same bound).
+        cname = query.get("c", "main")
+        rc_coll = self._coll_read(query)
+        ttl = float(getattr(rc_coll.conf, "result_cache_ttl", 0)
+                    if rc_coll is not None else 0)
+        ckey = None
+        if ttl > 0:
+            ver = rc_coll.posdb.version if rc_coll is not None else 0
+            ckey = (cname, q, n, s, fmt, ver)
+            hit = self._result_cache.get(ckey)
+            if hit is not None:
+                self.stats["result_cache_hits"] = \
+                    self.stats.get("result_cache_hits", 0) + 1
+                return hit
         if self.cluster is not None:
             # conf is only consulted for PQR factors — never create a
-            # local collection just to read it
-            c = self._coll_read(query)
-            res = self.cluster.search(q, topk=n, offset=s,
-                                      conf=c.conf if c else None)
+            # local collection just to read it (rc_coll above already
+            # did the read-only lookup)
+            res = self.cluster.search(
+                q, topk=n, offset=s,
+                conf=rc_coll.conf if rc_coll else None)
         elif self.sharded is not None:
             from ..parallel import sharded_search
             with self._lock:
@@ -450,6 +490,9 @@ class SearchHTTPServer:
                 res = engine.search(self._coll(query), q, topk=n,
                                     offset=s)
         payload, ctype = render_results(res, fmt)
+        if ckey is not None:
+            self._result_cache.put(ckey, (200, payload, ctype),
+                                   ttl_s=ttl)
         return 200, payload, ctype
 
     def _page_get(self, query: dict) -> tuple[int, str, str]:

@@ -64,6 +64,7 @@ PARMS: list[Parm] = [
     _p("ssl_key", "sslkey", str, "", GLOBAL, "TLS private key path (empty = key inside ssl_cert)", broadcast=False),
     _p("serve_device", "sdev", bool, True, GLOBAL, "serve /search from the HBM-resident index with micro-batching (SURVEY §7.8 throughput mode)"),
     _p("merge_quiet_hours", "mergehours", str, "", GLOBAL, "DailyMerge window (DailyMerge.h:11)"),
+    _p("alert_cmd", "alertcmd", str, "", GLOBAL, "command run on host death/recovery with OSSE_ALERT_* env (PingServer.h:77 email/SMS role); empty = log only", broadcast=False),
     # --- per-collection (coll.conf / CollectionRec) ---
     _p("docs_wanted", "n", int, 10, COLL, "results per page (SearchInput 'n')"),
     _p("site_cluster", "sc", bool, True, COLL, "max-2-per-site clustering (Msg51/Clusterdb)"),
@@ -76,6 +77,7 @@ PARMS: list[Parm] = [
     _p("title_max_len", "tml", int, 80, COLL, "title truncation (Title.cpp)"),
     _p("summary_excerpts", "ns", int, 3, COLL, "summary excerpt count (Summary.h)"),
     _p("pqr_enabled", "pqr", bool, True, COLL, "post-query rerank pass (PostQueryRerank.cpp)"),
+    _p("result_cache_ttl", "rcttl", float, 10.0, COLL, "seconds to cache rendered result pages (Msg17/Msg40Cache); 0 disables"),
     _p("pqr_lang_demote", "pqrlang", float, 0.8, COLL, "foreign-language demotion factor (m_pqr_demFactForeignLanguage)"),
     _p("pqr_site_demote", "pqrsite", float, 0.85, COLL, "per-extra-result same-domain demotion (PQR diversity role)"),
     _p("pqr_depth_demote", "pqrdepth", float, 0.97, COLL, "url path-depth demotion (prefer canonical pages)"),

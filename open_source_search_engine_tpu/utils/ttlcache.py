@@ -1,0 +1,64 @@
+"""General TTL record cache — the RdbCache role.
+
+The reference's ``RdbCache`` is the one cache class behind DNS,
+robots.txt, termlists, title recs and the Msg17 result cache. The
+specialized caches here grew ad hoc (termlist LRU, robots TTL, DNS
+TTL); this is the GENERAL form for new consumers: keyed TTL entries,
+bounded size with stalest-half eviction, thread-safe, with optional
+version tagging so a whole generation can be invalidated in O(1)
+(the Rdb-version trick the termlist cache uses).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Hashable
+
+
+class TtlCache:
+    def __init__(self, ttl_s: float = 3600.0, max_entries: int = 4096):
+        self.ttl_s = ttl_s
+        self.max_entries = max_entries
+        self._d: dict[Hashable, tuple[float, int, Any]] = {}
+        self._lock = threading.Lock()
+        self._version = 0
+        self.hits = 0
+        self.misses = 0
+
+    def bump_version(self) -> None:
+        """Invalidate every current entry in O(1) (new generation)."""
+        with self._lock:
+            self._version += 1
+
+    def get(self, key: Hashable):
+        now = time.monotonic()
+        with self._lock:
+            hit = self._d.get(key)
+            if hit is None or hit[0] < now or hit[1] != self._version:
+                self.misses += 1
+                return None
+            self.hits += 1
+            return hit[2]
+
+    def put(self, key: Hashable, value: Any,
+            ttl_s: float | None = None) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if len(self._d) >= self.max_entries:
+                for k in sorted(self._d,
+                                key=lambda k: self._d[k][0])[
+                        : self.max_entries // 2]:
+                    del self._d[k]
+            self._d[key] = (now + (ttl_s if ttl_s is not None
+                                   else self.ttl_s),
+                            self._version, value)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._d.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._d), "hits": self.hits,
+                    "misses": self.misses, "version": self._version}

@@ -1,0 +1,177 @@
+"""Coverage odds-and-ends: Msg17 result cache, general TtlCache,
+Users table auth, Catdb directory, dead-host alerting."""
+
+import json
+import os
+import time
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from open_source_search_engine_tpu.build import docproc
+from open_source_search_engine_tpu.index.catdb import Catdb
+from open_source_search_engine_tpu.index.collection import Collection
+from open_source_search_engine_tpu.query import engine
+from open_source_search_engine_tpu.serve.server import SearchHTTPServer
+from open_source_search_engine_tpu.utils.ttlcache import TtlCache
+from open_source_search_engine_tpu.utils.users import Users
+
+
+class TestTtlCache:
+    def test_ttl_and_eviction(self):
+        c = TtlCache(ttl_s=0.05, max_entries=4)
+        c.put("a", 1)
+        assert c.get("a") == 1
+        time.sleep(0.06)
+        assert c.get("a") is None
+        for i in range(5):
+            c.put(i, i)
+        assert c.stats()["entries"] <= 4
+
+    def test_version_invalidation(self):
+        c = TtlCache(ttl_s=60)
+        c.put("k", "v")
+        c.bump_version()
+        assert c.get("k") is None
+
+
+class TestResultCache:
+    def test_search_page_cached_and_invalidated(self, tmp_path):
+        srv = SearchHTTPServer(str(tmp_path), port=0)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            html = (b"<html><title>Cache</title><body>"
+                    b"<p>memoized llama content</p></body></html>")
+            urllib.request.urlopen(urllib.request.Request(
+                f"{base}/inject?url=http://c.test/1", data=html),
+                timeout=60)
+            urllib.request.urlopen(f"{base}/search?q=llama&format=json",
+                                   timeout=60)
+            h0 = srv.stats.get("result_cache_hits", 0)
+            urllib.request.urlopen(f"{base}/search?q=llama&format=json",
+                                   timeout=60)
+            assert srv.stats.get("result_cache_hits", 0) == h0 + 1
+            # an index mutation invalidates (version in the key)
+            urllib.request.urlopen(urllib.request.Request(
+                f"{base}/inject?url=http://c.test/2", data=html),
+                timeout=60)
+            out = json.load(urllib.request.urlopen(
+                f"{base}/search?q=llama&format=json", timeout=60))
+            assert out["totalMatches"] == 2  # fresh, not the cached 1
+        finally:
+            srv.stop()
+
+
+class TestUsers:
+    def test_roles_and_auth(self, tmp_path):
+        u = Users(tmp_path)
+        u.add("alice", "s3cret", role="admin")
+        u.add("bob", "hunter2", role="query")
+        assert u.check("alice", "s3cret", min_role="admin")
+        assert not u.check("alice", "wrong", min_role="admin")
+        assert not u.check("bob", "hunter2", min_role="admin")
+        assert u.check("bob", "hunter2", min_role="query")
+        assert not u.check("mallory", "x", min_role="query")
+        # persisted + reloadable, no cleartext on disk
+        raw = (tmp_path / "users.txt").read_text()
+        assert "s3cret" not in raw and "hunter2" not in raw
+        u2 = Users(tmp_path)
+        assert u2.check("alice", "s3cret", min_role="admin")
+
+    def test_server_accepts_user_credentials(self, tmp_path):
+        srv = SearchHTTPServer(str(tmp_path), port=0)
+        srv.conf.master_password = "masterpw"
+        srv.users.add("op", "oppw", role="admin")
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(f"{base}/admin/stats",
+                                       timeout=30)
+            with urllib.request.urlopen(
+                    f"{base}/admin/stats?user=op&upwd=oppw",
+                    timeout=30) as r:
+                assert r.status == 200
+            with urllib.request.urlopen(
+                    f"{base}/admin/stats?pwd=masterpw",
+                    timeout=30) as r:
+                assert r.status == 200  # master password still works
+        finally:
+            srv.stop()
+
+
+class TestCatdb:
+    TREE = ("1\t0\tScience\n"
+            "2\t1\tScience/Physics\n"
+            "3\t0\tArts\n")
+
+    def test_tree_and_assignment(self, tmp_path):
+        c = Catdb(tmp_path)
+        assert c.load_tree(self.TREE) == 3
+        c.assign("phys.test", 2)
+        assert c.categories_of("phys.test") == [2]
+        assert c.ancestors(2) == [2, 1]
+        assert c.catid_of_path("science/physics") == 2
+        # upward inheritance rides the *_top fields
+        f = c.doc_fields("phys.test")
+        assert f["catid"] == 2.0 and f["catid_top"] == 1.0
+        assert f["category"] == "Science/Physics"
+        assert f["category_top"] == "Science"
+        c.unassign("phys.test", 2)
+        assert c.categories_of("phys.test") == []
+
+    def test_directory_restricted_search(self, tmp_path):
+        coll = Collection("c", str(tmp_path))
+        coll.catdb.load_tree(self.TREE)
+        coll.catdb.assign("phys.test", 2)
+        docproc.index_document(
+            coll, "http://phys.test/a",
+            "<html><body><p>quantum electrodynamics paper about "
+            "muons</p></body></html>")
+        docproc.index_document(
+            coll, "http://other.test/b",
+            "<html><body><p>muons appear in this unfiled page "
+            "too</p></body></html>")
+        res = engine.search(coll, "muons", topk=5)
+        assert res.total_matches == 2
+        # directory-restricted: only the filed site's doc
+        res = engine.search(coll, "muons gbmin:catid:2 gbmax:catid:2",
+                            topk=5)
+        assert res.total_matches == 1
+        assert "phys.test" in res.results[0].url
+        # top-level restriction catches the whole subtree
+        res = engine.search(
+            coll, "muons gbmin:catid_top:1 gbmax:catid_top:1", topk=5)
+        assert res.total_matches == 1
+
+
+class TestAlerting:
+    def test_transition_fires_alert_cmd(self, tmp_path, monkeypatch):
+        from open_source_search_engine_tpu.parallel import \
+            cluster as cluster_mod
+        conf = cluster_mod.HostsConf(
+            n_shards=1, n_replicas=1, addresses=[["127.0.0.1:1"]])
+        cc = cluster_mod.ClusterClient(conf, use_heartbeat=False)
+        marker = tmp_path / "alert.txt"
+        # the alert_cmd PARM path (env cleared) must work too
+        monkeypatch.delenv("OSSE_ALERT_CMD", raising=False)
+        import types
+        cc.parms = types.SimpleNamespace(
+            alert_cmd=f'echo "$OSSE_ALERT_EVENT $OSSE_ALERT_HOST" '
+                      f'>> {marker}')
+        monkeypatch.setattr(cc, "_ping", lambda s, r: False)
+        cc.check_hosts()          # alive → dead fires
+        cc.check_hosts()          # still dead: no second alert
+        monkeypatch.setattr(cc, "_ping", lambda s, r: True)
+        cc.check_hosts()          # dead → recovered fires
+        for _ in range(50):
+            if marker.exists() and \
+                    len(marker.read_text().splitlines()) >= 2:
+                break
+            time.sleep(0.1)
+        lines = marker.read_text().splitlines()
+        assert len(lines) == 2
+        assert lines[0].startswith("dead ")
+        assert lines[1].startswith("recovered ")
