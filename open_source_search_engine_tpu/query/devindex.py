@@ -1375,8 +1375,12 @@ class DeviceIndex:
         k_req = min(_bucket(max(topk, 1), 64), self.D_cap)
         k2v = min(max(128, k_req), self.D_cap)
         # deep paging (TopTree top-X, X ≫ page): start the F2 selection
-        # rung at the requested depth so page-50 doesn't climb a ladder
-        f2_nsel = min(max(2048, _bucket(k_req, 2048)), self.D_cap)
+        # rung at the requested depth so page-50 doesn't climb a
+        # ladder. Big shards start a rung higher: at D ≥ 2^19 the
+        # 2048-block selection missed ~2% of queries (each miss reruns
+        # a multi-second wave) while the wider top_k costs ~nothing.
+        f2_floor = 4096 if self.D_cap >= (1 << 19) else 2048
+        f2_nsel = min(max(f2_floor, _bucket(k_req, 2048)), self.D_cap)
         bmax = self._f2_bmax()
         while f1 or f2:
             t_issue = time.perf_counter()
@@ -1567,7 +1571,9 @@ class DeviceIndex:
         # B > 4 buckets exist only when the HBM budget allows them
         nb_big = (1, 5) if self._f2_bmax() > 4 else (1,)
         nb_fd = (1, 5) if self._fd_bmax() > 4 else (1,)
-        for n_sel in (2048, 8192):  # F2 base + first escalation rung
+        # selection rungs match search_batch's f2_floor ladder
+        ns0 = 4096 if self.D_cap >= (1 << 19) else 2048
+        for n_sel in (ns0, 4 * ns0):  # F2 base + first escalation rung
             for np_rows in (1, 9):
                 for nb in nb_big:  # B = 4 and (budget allowing) B = bmax
                     p = dummy(np_rows=np_rows)
@@ -1602,13 +1608,13 @@ class DeviceIndex:
         pl2.p_len[0] = F2_LPOST_FLOOR + 1  # Lp=16384 bucket (big
         # bigram scatter tails — one unwarmed hit cost a 91 s compile
         # inside a measured pass)
-        for n_sel in (2048, 8192):
+        for n_sel in (ns0, 4 * ns0):
             for nb in nb_fd:
                 outs.append(self._run_batch_fd(
                     [pd] * nb, k2, min(n_sel, self.D_cap)))
                 outs.append(self._run_batch_fd(
                     [pd0] * nb, k2, min(n_sel, self.D_cap)))
-                if n_sel == 2048:
+                if n_sel == ns0:
                     outs.append(self._run_batch_fd(
                         [pt] * nb, k2, min(n_sel, self.D_cap)))
                     outs.append(self._run_batch_fd(

@@ -36,9 +36,13 @@ from ..index.posdb import HASHGROUP_END, HASHGROUP_INLINKTEXT
 from . import weights
 from .scorer import MAX_PAIR_SPAN, QDIST
 
-#: doc-axis tile width (lane-dim multiple of 128; [P, P, TILE] f32
-#: buffers at 512 are 512 KB — a handful fit VMEM comfortably)
-TILE_D = 512
+#: doc-axis tile width (lane-dim multiple of 128). Sized UP to 1024:
+#: the FD grid runs T·4 steps per (query, tile), so step-dispatch
+#: overhead — not bandwidth — floors the wave time; 1024-wide tiles
+#: halve the step count while the working set (~8 MB at T=8: decode
+#: products + two live [P, P, TILE] pair buffers + the [T·4, P4,
+#: TILE] cube scratch) still fits v5e's ~16 MB VMEM.
+TILE_D = 1024
 
 #: use the fused kernels only where they pay: corpus-wide doc axes.
 #: Small phase-2 cubes (κ ≤ 2048) fuse fine under plain XLA.
@@ -194,53 +198,65 @@ def min_scores_fused(cube, freqw, counts, interpret: bool = False):
 
 # --------------------------------------------------------------- FD path
 
-def _fd_kernel(gq_ref, syn_ref, row_ref, *rest, T: int, P: int,
+def _fd_kernel(gq_ref, syn_ref, rows_hbm, *rest, T: int, P: int,
                has_tail: bool):
-    """Grid (B, D/TILE, T·4): accumulate one quarter-row slice per
-    step into the VMEM cube tile; score on the last quarter. Waves
-    whose every query is pure quarter-rows (no posting tail — the
-    common FD case) compile WITHOUT the tail input, skipping a
-    cube-sized HBM write+read per query."""
+    """Grid (B, D/TILE): ONE step per (query, doc tile). The step
+    issues T·4 async DMAs pulling the query's quarter-row slices from
+    the HBM-resident cube straight into the VMEM scratch (a grid axis
+    per quarter paid ~8 µs of step dispatch to move 16 KB — the DMA
+    form is ~16× fewer steps), waits, assembles, scores. Waves whose
+    every query is pure quarter-rows (no posting tail — the common FD
+    case) compile WITHOUT the tail input, skipping a cube-sized HBM
+    write+read per query."""
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     if has_tail:
         tail_ref, dead_ref, fw_ref, cnt_ref, ms_ref, pres_ref, \
-            acc_ref = rest
+            acc_ref, sems = rest
     else:
-        dead_ref, fw_ref, cnt_ref, ms_ref, pres_ref, acc_ref = rest
+        dead_ref, fw_ref, cnt_ref, ms_ref, pres_ref, acc_ref, \
+            sems = rest
 
     b = pl.program_id(0)
-    tq = pl.program_id(2)
-    P4 = P // 4
+    d = pl.program_id(1)
+    TQ = T * 4
+    TD = acc_ref.shape[2]
 
-    @pl.when(tq == 0)
-    def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
+    def dma(tq):
+        return pltpu.make_async_copy(
+            rows_hbm.at[gq_ref[b, tq], :, pl.dslice(d * TD, TD)],
+            acc_ref.at[tq], sems.at[tq])
 
-    row = row_ref[0]                                # [P4, TILE] u32
-    synbit = (syn_ref[b, tq].astype(jnp.uint32) << jnp.uint32(31))
-    # scratch is [T·4, P4, TILE]: a whole-row store at a dynamic tq
-    # keeps the sublane dim full (Mosaic requires sublane offsets to
-    # be 8-aligned; q·P4 is not). The row-major regrouping
-    # [T·4, P4] → [T, 4·P4] below lands quarter q of term t exactly at
-    # positions q·P4.. — the g_quarter layout.
-    acc_ref[pl.dslice(tq, 1), :, :] = \
-        jnp.where(row != 0, row | synbit, row)[None]
+    for tq in range(TQ):
+        dma(tq).start()
+    for tq in range(TQ):
+        dma(tq).wait()
 
-    @pl.when(tq == pl.num_programs(2) - 1)
-    def _score():
-        live = dead_ref[0] == 0                     # [TILE]
-        cube = jnp.where(live[None, None, :],
-                         acc_ref[...].reshape(T, P, acc_ref.shape[2]),
-                         jnp.uint32(0))
-        if has_tail:
-            # tail postings were dead-filtered at scatter time (delta
-            # postings of re-added docs live PAST the dead mask) — OR
-            # after masking. Slot ranges are disjoint by the slot plan.
-            cube = cube | tail_ref[0]
-        ms, pres = _score_tile(cube, fw_ref[0, 0], cnt_ref[0, 0], T, P)
-        ms_ref[0, 0] = ms
-        pres_ref[0, 0] = pres
+    # per-quarter synonym bit, read from the prefetched scalars and
+    # OR'd in place (a [TQ]→[TQ,1,1] vector broadcast is an
+    # unsupported Mosaic shape cast; the scalar form also skips the
+    # no-synonym common case entirely)
+    for tq in range(TQ):
+        sb = (syn_ref[b, tq].astype(jnp.uint32) << jnp.uint32(31))
+
+        @pl.when(sb != 0)
+        def _orsyn(tq=tq, sb=sb):
+            r = acc_ref[tq]
+            acc_ref[tq] = jnp.where(r != 0, r | sb, r)
+
+    rows = acc_ref[...]                             # [T·4, P4, TD]
+    live = dead_ref[0] == 0                         # [TD]
+    cube = jnp.where(live[None, None, :], rows.reshape(T, P, TD),
+                     jnp.uint32(0))
+    if has_tail:
+        # tail postings were dead-filtered at scatter time (delta
+        # postings of re-added docs live PAST the dead mask) — OR
+        # after masking. Slot ranges are disjoint by the slot plan.
+        cube = cube | tail_ref[0]
+    ms, pres = _score_tile(cube, fw_ref[0, 0], cnt_ref[0, 0], T, P)
+    ms_ref[0, 0] = ms
+    pres_ref[0, 0] = pres
 
 
 @functools.partial(jax.jit,
@@ -292,36 +308,37 @@ def _fd_call(g_quarter, g_qsyn, d_cube, tail_cube, dead_i32,
     cnt = counts.astype(jnp.float32).reshape(B, 1, T)
 
     in_specs = [
-        pl.BlockSpec((1, P4, TILE_D),
-                     lambda b, d, tq, gq, syn: (gq[b, tq], 0, d)),
+        pl.BlockSpec(memory_space=pltpu.ANY),   # resident rows: HBM
     ]
     operands = [rows3]
     if has_tail:
         in_specs.append(
             pl.BlockSpec((1, T, P, TILE_D),
-                         lambda b, d, tq, gq, syn: (b, 0, 0, d)))
+                         lambda b, d, gq, syn: (b, 0, 0, d)))
         operands.append(tail_cube)
     in_specs += [
         pl.BlockSpec((1, TILE_D),
-                     lambda b, d, tq, gq, syn: (0, d)),
+                     lambda b, d, gq, syn: (0, d)),
         pl.BlockSpec((1, 1, T),
-                     lambda b, d, tq, gq, syn: (b, 0, 0)),
+                     lambda b, d, gq, syn: (b, 0, 0)),
         pl.BlockSpec((1, 1, T),
-                     lambda b, d, tq, gq, syn: (b, 0, 0)),
+                     lambda b, d, gq, syn: (b, 0, 0)),
     ]
     operands += [dead_i32, fw, cnt]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,       # g_quarter, g_qsyn
-        grid=(B, D // TILE_D, TQ),
+        grid=(B, D // TILE_D),
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, TILE_D),
-                         lambda b, d, tq, gq, syn: (b, 0, d)),
+                         lambda b, d, gq, syn: (b, 0, d)),
             pl.BlockSpec((1, 1, TILE_D),
-                         lambda b, d, tq, gq, syn: (b, 0, d)),
+                         lambda b, d, gq, syn: (b, 0, d)),
         ],
-        scratch_shapes=[pltpu.VMEM((T * 4, P // 4, TILE_D),
-                                   jnp.uint32)],
+        scratch_shapes=[
+            pltpu.VMEM((T * 4, P // 4, TILE_D), jnp.uint32),
+            pltpu.SemaphoreType.DMA((T * 4,)),
+        ],
     )
     ms, pres = pl.pallas_call(
         functools.partial(_fd_kernel, T=T, P=P, has_tail=has_tail),
