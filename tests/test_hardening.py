@@ -47,7 +47,7 @@ class TestTLS:
 class TestNiceness:
     def test_nice1_waits_for_interactive(self, tmp_path):
         srv = SearchHTTPServer(str(tmp_path / "d"), port=0)
-        srv.nice_gate.max_wait_s = 0.3
+        srv.nice_gate.max_wait_s = 1.0
         # interactive request in flight → niceness-1 must wait
         srv.nice_gate.enter(0)
         t0 = time.monotonic()
@@ -55,16 +55,17 @@ class TestNiceness:
                                   niceness=1)
         waited = time.monotonic() - t0
         assert status == 200
-        assert waited >= 0.25
-        # idle plane → niceness-1 runs immediately
+        assert waited >= 0.9
+        # idle plane → niceness-1 runs without the gate wait (margin
+        # generous: the handler itself can be slow under suite load)
         srv.nice_gate.exit(0)
         t0 = time.monotonic()
         srv.handle("GET", "/admin/stats", {}, b"", niceness=1)
-        assert time.monotonic() - t0 < 0.2
+        assert time.monotonic() - t0 < 0.5
 
     def test_header_parsed(self, tmp_path):
         srv = SearchHTTPServer(str(tmp_path / "d"), port=0)
-        srv.nice_gate.max_wait_s = 0.2
+        srv.nice_gate.max_wait_s = 0.5
         srv.start()
         try:
             srv.nice_gate.enter(0)
@@ -74,7 +75,7 @@ class TestNiceness:
             t0 = time.monotonic()
             with urllib.request.urlopen(req, timeout=30) as r:
                 assert r.status == 200
-            assert time.monotonic() - t0 >= 0.15
+            assert time.monotonic() - t0 >= 0.4
         finally:
             srv.nice_gate.exit(0)
             srv.stop()
