@@ -48,6 +48,81 @@ N_LAT = int(os.environ.get("BENCH_LAT_QUERIES", "64"))
 VOCAB = 2000
 
 
+def _load_scale() -> dict:
+    """This machine's cache of measured runs (one entry per corpus
+    size, latest wins)."""
+    scale_path = os.path.expanduser("~/.cache/osse_bench_scale.json")
+    try:
+        with open(scale_path) as f:
+            return json.load(f)
+    except Exception:
+        return {}
+
+
+def _curve_of(scale: dict) -> list[dict]:
+    # each point carries the commit + replay size it was measured at —
+    # the cache spans runs, and a curve must not pass off stale or
+    # smoke-sized points as current
+    return [{"docs": int(d), **{k: v.get(k) for k in
+                                ("qps", "p50_ms", "recall_at_10",
+                                 "recall_queries", "replay_n",
+                                 "commit")}}
+            for d, v in sorted(scale.items(), key=lambda kv:
+                               int(kv[0]))
+            if int(d) >= 10000]  # smoke-sized runs aren't the curve
+
+
+def _init_backend(max_tries: int = 3):
+    """Backend init with bounded retry-with-backoff — the tunneled TPU
+    client's first device enumeration is the observed wedge point, and
+    transient RPC failures there must not burn a whole bench run.
+    Returns the jax module; raises the last error once retries are
+    exhausted (callers then emit the cached curve, see
+    _emit_stale_curve)."""
+    last: Exception | None = None
+    base = float(os.environ.get("BENCH_INIT_BACKOFF_S", "5"))
+    for attempt in range(max_tries):
+        try:
+            import jax
+            jax.devices()  # forces backend client init
+            return jax
+        except Exception as e:  # noqa: BLE001 — any init failure
+            last = e
+            wait = base * (2 ** attempt)
+            print(f"# backend init failed "
+                  f"(attempt {attempt + 1}/{max_tries}): {e}; "
+                  f"retrying in {wait}s", file=sys.stderr)
+            try:  # drop the poisoned client so the retry re-inits
+                import jax.extend.backend
+                jax.extend.backend.clear_backends()
+            except Exception:
+                pass
+            time.sleep(wait)
+    raise last  # type: ignore[misc]
+
+
+def _emit_stale_curve(reason: str) -> None:
+    """Persistent backend failure: print the last-good cached scale
+    curve marked ``"stale": true`` and exit 0 — a parseable
+    degraded answer instead of rc=1 with no JSON line (which reads
+    as a wedged bench and discards every prior measurement)."""
+    curve = _curve_of(_load_scale())
+    latest = curve[-1] if curve else {}
+    qps = latest.get("qps") or 0.0
+    print(json.dumps({
+        "metric": "queries_per_sec",
+        "value": round(qps, 2),
+        "unit": "qps",
+        "vs_baseline": round(qps / BASELINE_QPS, 2),
+        "stale": True,
+        "error": reason[:300],
+        "docs": latest.get("docs", 0),
+        "scale": curve,
+    }))
+    print(f"# backend unavailable ({reason[:120]}); emitted last-good "
+          "cached curve", file=sys.stderr)
+
+
 def _gen_docs(n_docs: int):
     """Synthetic zipf-vocabulary HTML corpus (deterministic)."""
     import numpy as np
@@ -100,6 +175,11 @@ def main_mesh(n_shards: int) -> None:
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
+    try:
+        jax = _init_backend()
+    except Exception as e:  # noqa: BLE001
+        _emit_stale_curve(f"backend init failed after retries: {e}")
+        return
 
     from open_source_search_engine_tpu.parallel.sharded import (
         MeshResident, ShardedCollection)
@@ -132,7 +212,11 @@ def main_mesh(n_shards: int) -> None:
 
 
 def main() -> None:
-    import jax
+    try:
+        jax = _init_backend()
+    except Exception as e:  # noqa: BLE001
+        _emit_stale_curve(f"backend init failed after retries: {e}")
+        return
 
     # persistent XLA compile cache: warmup cost amortizes across runs
     try:
@@ -329,11 +413,7 @@ def main() -> None:
     # claim vs the reference's "halves as index doubles"
     # (html/faq.html:320) needs the curve, not one point
     scale_path = os.path.expanduser("~/.cache/osse_bench_scale.json")
-    try:
-        with open(scale_path) as f:
-            scale = json.load(f)
-    except Exception:
-        scale = {}
+    scale = _load_scale()
     try:
         import subprocess
         commit = subprocess.run(
@@ -354,16 +434,7 @@ def main() -> None:
             json.dump(scale, f)
     except Exception:
         pass
-    # each point carries the commit + replay size it was measured at —
-    # the cache spans runs, and a curve must not pass off stale or
-    # smoke-sized points as current
-    curve = [{"docs": int(d), **{k: v.get(k) for k in
-                                 ("qps", "p50_ms", "recall_at_10",
-                                  "recall_queries", "replay_n",
-                                  "commit")}}
-             for d, v in sorted(scale.items(), key=lambda kv:
-                                int(kv[0]))
-             if int(d) >= 10000]  # smoke-sized runs aren't the curve
+    curve = _curve_of(scale)
 
     print(json.dumps({
         "metric": "queries_per_sec",

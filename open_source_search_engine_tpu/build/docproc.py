@@ -38,6 +38,7 @@ from ..index.collection import Collection
 from ..utils import ghash
 from ..utils.lang import detect_language
 from ..utils.log import get_logger
+from ..utils.membudget import g_membudget
 from ..utils.url import normalize
 from .tokenizer import (_WORD_RE, TokenizedDoc, tokenize_html,
                         tokenize_text)
@@ -742,19 +743,44 @@ def index_batch(coll: Collection, docs, *, is_html: bool = True,
     if not metas:
         _run_leftovers()
         return out
-    # --- phase C writes: ONE add per Rdb ---
-    coll.posdb.add(np.concatenate([ml.posdb_keys for ml in metas]))
-    coll.titledb.add(
-        np.concatenate([ml.titledb_key.reshape(1) for ml in metas]),
-        [ml.title_rec for ml in metas])
-    coll.clusterdb.add(
-        np.concatenate([ml.clusterdb_key.reshape(1) for ml in metas]))
-    withf = [ml for ml in metas
-             if ml.fielddb_keys is not None and len(ml.fielddb_keys)]
-    if withf:
-        coll.fielddb.add(
-            np.concatenate([ml.fielddb_keys for ml in withf]),
-            [b for ml in withf for b in ml.fielddb_blobs])
+    # --- phase C writes: ONE add per Rdb, gated by the memory budget.
+    # Over budget the batch SHEDS: split in half and write the halves
+    # separately, so the concatenated key images stay bounded and the
+    # memtable can dump between chunks (the g_mem degradation arm for
+    # the build pipeline — slower, never OOM).
+    def _phase_c_estimate(chunk):
+        return (sum(int(ml.posdb_keys.nbytes) for ml in chunk)
+                + sum(len(ml.title_rec) for ml in chunk)
+                + 64 * len(chunk))  # small keys (title/cluster/field)
+
+    def _phase_c_write(chunk):
+        coll.posdb.add(np.concatenate([ml.posdb_keys for ml in chunk]))
+        coll.titledb.add(
+            np.concatenate([ml.titledb_key.reshape(1) for ml in chunk]),
+            [ml.title_rec for ml in chunk])
+        coll.clusterdb.add(
+            np.concatenate([ml.clusterdb_key.reshape(1) for ml in chunk]))
+        withf = [ml for ml in chunk
+                 if ml.fielddb_keys is not None and len(ml.fielddb_keys)]
+        if withf:
+            coll.fielddb.add(
+                np.concatenate([ml.fielddb_keys for ml in withf]),
+                [b for ml in withf for b in ml.fielddb_blobs])
+
+    pending = [metas]
+    while pending:
+        chunk = pending.pop(0)
+        with g_membudget.reserving(
+                "docproc", _phase_c_estimate(chunk)) as granted:
+            if not granted and len(chunk) > 1:
+                mid = len(chunk) // 2
+                log.warning("index_batch: %d-doc write over memory "
+                            "budget — shedding to halves", len(chunk))
+                pending[:0] = [chunk[:mid], chunk[mid:]]
+                continue
+            # a refused SINGLE doc still writes: correctness beats the
+            # budget once degradation has nothing left to shed
+            _phase_c_write(chunk)
     for (i, u, url, content, site, sr), ml in zip(work, metas):
         coll.sectiondb.add_page_sections(site, u.full, ml.sections)
         coll.titlerec_cache.pop(ml.docid, None)

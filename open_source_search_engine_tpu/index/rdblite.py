@@ -41,12 +41,18 @@ from pathlib import Path
 import numpy as np
 
 from ..utils.log import get_logger
+from ..utils.membudget import g_membudget
 
 log = get_logger("rdb")
 
 #: keys per RdbMap "page" — the reference maps one key per 16KB disk page
 #: (``RdbMap.h:64``); ours indexes every PAGE_KEYS keys of a run.
 PAGE_KEYS = 4096
+
+#: don't let global budget pressure thrash the memtable into confetti
+#: runs: an early (pressure-triggered) dump needs at least this much
+#: buffered before it fires.
+_EARLY_DUMP_FLOOR = 1 << 20
 
 
 class CorruptRunError(Exception):
@@ -529,6 +535,20 @@ class Rdb:
             _os.environ.get("OSSE_NO_JOURNAL") != "1"
         self._journal_path = self.dir / "addsinprogress.bin"
         self._journal_f = None
+        if not self.journal_enabled and self._journal_path.exists() \
+                and self._journal_path.stat().st_size > 0:
+            # this open won't journal OR truncate-on-dump, so records
+            # added now are invisible to the file; a later
+            # journal-enabled open would replay the stale batches over
+            # newer state (resurrecting tombstoned records). Truncate
+            # up front — a journal-less open declares the source data
+            # durable, so the stale tail buys nothing.
+            log.warning(
+                "%s: journaling disabled but %s holds %d stale bytes — "
+                "truncating so a later journal-enabled open cannot "
+                "replay them", self.name, self._journal_path.name,
+                self._journal_path.stat().st_size)
+            self._journal_path.write_bytes(b"")
         self._load_existing_runs()
 
     # --- writes ---
@@ -540,7 +560,13 @@ class Rdb:
         self._journal_append(keys, blobs)
         self.mem.add(keys, blobs)
         self.version += 1
-        if self.mem.nbytes >= self.max_memtable_bytes:
+        g_membudget.set_gauge("memtable", str(self.dir), self.mem.nbytes)
+        # dump at the per-tree bound (reference 90%-full trigger) OR
+        # early when the PROCESS budget is exhausted — the "flush the
+        # memtable" degradation arm of the g_mem gate
+        if self.mem.nbytes >= self.max_memtable_bytes or (
+                self.mem.nbytes >= _EARLY_DUMP_FLOOR
+                and not g_membudget.would_fit(0)):
             self.dump()
 
     def delete(self, keys: np.ndarray) -> None:
@@ -550,11 +576,13 @@ class Rdb:
         self._journal_append(neg, blobs)
         self.mem.add(neg, blobs)
         self.version += 1
+        g_membudget.set_gauge("memtable", str(self.dir), self.mem.nbytes)
 
     def wipe(self) -> None:
         """Drop ALL state (memtable + runs) — the Repair rebuild's
         'destroy the secondary instance' step (Repair.h:20)."""
         self.mem.clear()
+        g_membudget.set_gauge("memtable", str(self.dir), 0)
         for r in self.runs:
             shutil.rmtree(r.path, ignore_errors=True)
         self.runs = []
@@ -573,6 +601,7 @@ class Rdb:
         self._next_run_id += 1
         self.runs.append(run)
         self.mem.clear()
+        g_membudget.set_gauge("memtable", str(self.dir), 0)
         self.version += 1  # run set moved: device mirrors must re-base
         # the memtable checkpoint is now stale — drop it so a restart can't
         # resurrect records that live in the freshly dumped run
@@ -608,28 +637,56 @@ class Rdb:
             start = self.max_runs - 1
         else:
             start = len(self.runs) - 2  # opportunistic: fold newest two
-        suffix = self.runs[start:]
-        includes_oldest = start == 0
-        merged = merge_batches(
-            [r.batch() for r in suffix],
-            keep_tombstones=not includes_oldest,
-        )
-        old = suffix
-        # the merged run REPLACES the suffix in recency order: derive a
-        # name that sorts right after the surviving prefix
-        # name keeps only the first NUMERIC id so repeated merge cycles
-        # don't grow the filename; the _m counter keeps recency order
-        base_id = int(old[0].path.name.split("_")[1])
-        run = Run.write(
-            self.dir / f"run_{base_id:06d}_m{self._next_run_id:06d}",
-            merged)
-        self._next_run_id += 1
-        self.runs = self.runs[:start] + [run]
-        self.version += 1  # run set moved: device mirrors must re-base
-        for r in old:
-            shutil.rmtree(r.path)
-        log.debug("%s: merged %d newest runs -> %s (%d recs, %d kept)",
-                  self.name, len(old), run.path.name, len(run), start)
+        # budget gate (the g_mem allocation canary): a merge
+        # materializes its inputs plus the merged output, ~2× the input
+        # bytes. On refusal SHRINK the suffix — merge fewer, newer runs
+        # — and if even the smallest 2-run merge is over budget, DEFER:
+        # the next dump retries, and an unmerged index is slow but
+        # correct while an OOM-killed process is neither.
+        start0, est = start, 0
+        while True:
+            est = 2 * sum(
+                int(r.keys.nbytes)
+                + (int(r.data.nbytes) if r.data is not None else 0)
+                for r in self.runs[start:])
+            if g_membudget.reserve("merge", est):
+                break
+            if start >= len(self.runs) - 2:
+                log.warning(
+                    "%s: merge deferred — even the 2-run merge "
+                    "(%d MB working set) is over budget",
+                    self.name, est >> 20)
+                return
+            start += 1
+        if start != start0:
+            log.warning("%s: merge shrunk to the newest %d runs "
+                        "(budget pressure)", self.name,
+                        len(self.runs) - start)
+        try:
+            suffix = self.runs[start:]
+            includes_oldest = start == 0
+            merged = merge_batches(
+                [r.batch() for r in suffix],
+                keep_tombstones=not includes_oldest,
+            )
+            old = suffix
+            # the merged run REPLACES the suffix in recency order: derive a
+            # name that sorts right after the surviving prefix
+            # name keeps only the first NUMERIC id so repeated merge cycles
+            # don't grow the filename; the _m counter keeps recency order
+            base_id = int(old[0].path.name.split("_")[1])
+            run = Run.write(
+                self.dir / f"run_{base_id:06d}_m{self._next_run_id:06d}",
+                merged)
+            self._next_run_id += 1
+            self.runs = self.runs[:start] + [run]
+            self.version += 1  # run set moved: device mirrors must re-base
+            for r in old:
+                shutil.rmtree(r.path)
+            log.debug("%s: merged %d newest runs -> %s (%d recs, %d kept)",
+                      self.name, len(old), run.path.name, len(run), start)
+        finally:
+            g_membudget.release("merge", est)
 
     def scrub(self) -> list[str]:
         """Re-verify every loaded run NOW; quarantine failures (the
@@ -827,6 +884,8 @@ class Rdb:
             n_rec += int(n)
         if n_rec:
             self.version += 1
+            g_membudget.set_gauge("memtable", str(self.dir),
+                                  self.mem.nbytes)
             log.info("%s: replayed %d journaled records "
                      "(addsinprogress)", self.name, n_rec)
             if self.mem.nbytes >= self.max_memtable_bytes:

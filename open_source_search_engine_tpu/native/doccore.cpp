@@ -416,11 +416,22 @@ static std::string decode_entities(const char* s, size_t len,
         if (ent.empty()) { out += s[i++]; continue; }
         if (ent[0] == '#') {
             // python resolves numeric charrefs even without ';'
-            uint32_t cp =
-                (ent.size() > 1 && (ent[1] == 'x' || ent[1] == 'X'))
-                    ? (uint32_t)strtoul(ent.c_str() + 2, 0, 16)
-                    : (uint32_t)strtoul(ent.c_str() + 1, 0, 10);
-            if (ent.size() <= 1 || cp == 0) { out += s[i++]; continue; }
+            bool hex =
+                ent.size() > 1 && (ent[1] == 'x' || ent[1] == 'X');
+            // digit-less forms (&#;, &#x;) are not charrefs — literal,
+            // exactly what html.unescape's charref regex requires
+            bool has_digits = hex
+                ? (ent.size() > 2 && isxdigit((uint8_t)ent[2]))
+                : (ent.size() > 1 && isdigit((uint8_t)ent[1]));
+            if (!has_digits) { out += s[i++]; continue; }
+            uint32_t cp = hex
+                ? (uint32_t)strtoul(ent.c_str() + 2, 0, 16)
+                : (uint32_t)strtoul(ent.c_str() + 1, 0, 10);
+            // html.unescape maps NUL, surrogate code points and
+            // beyond-Unicode values to U+FFFD
+            if (cp == 0 || (cp >= 0xD800 && cp <= 0xDFFF)
+                    || cp > 0x10FFFF)
+                cp = 0xFFFD;
             char enc[4];
             out.append(enc, u8_encode(cp, enc));
             i = has_semi ? j + 1 : j;

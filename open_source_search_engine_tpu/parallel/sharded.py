@@ -46,9 +46,21 @@ from ..query.packer import (MAX_POSITIONS, PackedQuery, PreparedQuery,
                             _pad1, group_flags, pack_pass, prepare_query)
 from ..query.scorer import score_core
 from ..utils.log import get_logger
+from ..utils.membudget import g_membudget
 from .hostmap import SHARD_AXIS, HostMap, make_mesh
 
 log = get_logger("parallel")
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    """jax.shard_map moved out of experimental across jax releases and
+    renamed check_rep → check_vma; dispatch on what this jax has."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+    return shard_map(f, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)
 
 
 def _docid_of(url: str) -> int:
@@ -119,6 +131,28 @@ class ShardedCollection:
         self.mutations = 0
         #: site-routed tag store (bans / boundaries / overrides)
         self.tagdb = ShardedTagdb(self)
+        # budget-pressure hook: over-budget reserve() asks us to dump
+        # fat memtables across the grid before it refuses (held by
+        # weakref, so registration never pins a dead collection)
+        g_membudget.add_pressure_handler(self._relieve_memory)
+
+    def _relieve_memory(self, need: int) -> int:
+        """Flush the grid's largest memtables until ~``need`` bytes are
+        freed (the 'dump the tree' arm of the g_mem gate)."""
+        freed = 0
+        rdbs = [rdb for row in self.grid for coll in row
+                for rdb in coll.rdbs().values()
+                if rdb.mem.nbytes >= 1 << 20]
+        rdbs.sort(key=lambda r: r.mem.nbytes, reverse=True)
+        for rdb in rdbs:
+            if freed >= need:
+                break
+            freed += rdb.mem.nbytes
+            rdb.dump()
+        if freed:
+            log.info("budget pressure: dumped %d MB of memtables",
+                     freed >> 20)
+        return freed
 
     @property
     def n_shards(self) -> int:
@@ -461,11 +495,10 @@ def _sharded_score(mesh, doc_idx, payload, slot, valid, freq_weight,
             jax.lax.bitcast_convert_type(m_sc, jnp.uint32),
         ])
 
-    return jax.shard_map(
+    return _shard_map(
         per_shard, mesh=mesh,
         in_specs=(spec,) * 16,
         out_specs=rep,
-        check_vma=False,
     )(doc_idx, payload, slot, valid, freq_weight, required, negative,
       scored, counts, table, siterank, doclang, qlang, n_docs, filt,
       sortc)

@@ -1,0 +1,225 @@
+"""Device-plane guardrails — checkify/debug_nans harness for the kernels.
+
+The host plane's twin (``utils/membudget.py`` carries the Mem.cpp
+budget role): Gigablast's device-free core relied on allocation
+canaries and ``checkList_r`` sanity sweeps to turn silent corruption
+into loud errors; the TPU-native equivalent (SURVEY §5) is
+``jax.experimental.checkify`` + ``jax_debug_nans``. This module wraps
+the F1/FD/F2 kernel routes with on-device assertions:
+
+* **score finiteness** — no NaN/inf leaves a scoring wave;
+* **top-k monotonicity** — emitted scores are non-increasing (the
+  selection contract every consumer — merge, paging, escalation's
+  kth-score check — silently depends on);
+* **index bounds** — every live (score > 0) top-k row indexes a real
+  doc (``idx < n_docs``; the dead-mask/pad contract);
+* **cube payload sanity** — nonzero payloads decode to a hashgroup
+  ``< HASHGROUP_END`` (a corrupt tile shows up here first: random
+  bytes have hashgroup 11–15 with probability 5/16 per position).
+
+Everything is **opt-in**: ``OSSE_CHECKIFY=1`` in the environment or
+the ``checkify`` parm (serve wiring calls :func:`set_enabled`). Off,
+the hot path pays one dict lookup. A trip raises
+:class:`DeviceCheckError` with the failing route and bumps
+``devcheck.trip`` counters in ``g_stats`` (statsdb surfaces them).
+
+The fault injector (:func:`inject`) corrupts wave outputs / cube
+payloads *before* the checks so tests prove the harness fires — the
+reference's "write garbage, watch the canary scream" discipline.
+Checks run in both eager ("interpret") and jitted modes; tier-1 CI
+exercises both under ``JAX_PLATFORMS=cpu``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+
+import numpy as np
+
+from ..index.posdb import HASHGROUP_END
+from ..utils.log import get_logger
+from ..utils.stats import g_stats
+
+log = get_logger("devcheck")
+
+#: monotonicity slack: float32 reassociation across kernel variants
+_TIE_EPS = 1e-5
+
+#: parm override (None = the OSSE_CHECKIFY env var decides)
+_flag: bool | None = None
+
+#: active fault kind (None | "nan" | "oob_docid" | "corrupt_tile")
+_fault: str | None = None
+
+
+class DeviceCheckError(RuntimeError):
+    """An on-device guardrail assertion tripped."""
+
+
+def set_enabled(on: bool | None) -> None:
+    """Parm hook: True forces on, None defers to ``OSSE_CHECKIFY``."""
+    global _flag
+    _flag = on
+
+
+def enabled() -> bool:
+    if _flag is not None:
+        return _flag
+    return os.environ.get("OSSE_CHECKIFY", "") == "1"
+
+
+# --------------------------------------------------------------- checks
+
+def _topk_invariants(scores, idx, n_docs):
+    import jax.numpy as jnp
+    from jax.experimental import checkify
+    checkify.check(jnp.all(jnp.isfinite(scores)),
+                   "non-finite top-k score left the device "
+                   "(nan_count={n})",
+                   n=jnp.sum(~jnp.isfinite(scores)))
+    if scores.shape[0] > 1:
+        checkify.check(
+            jnp.all(scores[:-1] >= scores[1:] - _TIE_EPS),
+            "top-k scores not monotonic non-increasing "
+            "(first violation rank={r})",
+            r=jnp.argmax(scores[:-1] < scores[1:] - _TIE_EPS))
+    live = scores > 0.0
+    in_bounds = (idx >= 0) & (idx < n_docs)
+    checkify.check(
+        jnp.all(jnp.where(live, in_bounds, True)),
+        "out-of-range docid index in live top-k row "
+        "(idx={i} >= n_docs={n})",
+        i=jnp.max(jnp.where(live, idx, -1)), n=n_docs)
+    return jnp.int32(0)
+
+
+def _cube_invariants(cube):
+    import jax.numpy as jnp
+    from jax.experimental import checkify
+    hg = (cube >> jnp.uint32(18)) & jnp.uint32(0xF)
+    bad = (cube != 0) & (hg >= HASHGROUP_END)
+    checkify.check(
+        ~jnp.any(bad),
+        "corrupt position-cube tile: {n} nonzero payloads decode to "
+        "hashgroup >= HASHGROUP_END", n=jnp.sum(bad))
+    return jnp.int32(0)
+
+
+@functools.lru_cache(maxsize=None)
+def _checked(fn_name: str, use_jit: bool):
+    import jax
+    from jax.experimental import checkify
+    fn = {"topk": _topk_invariants, "cube": _cube_invariants}[fn_name]
+    return checkify.checkify(jax.jit(fn) if use_jit else fn)
+
+
+def _use_jit() -> bool:
+    """jit by default; OSSE_CHECKIFY_INTERPRET=1 runs the checks
+    eagerly (the interpret-mode CI leg)."""
+    return os.environ.get("OSSE_CHECKIFY_INTERPRET", "") != "1"
+
+
+def _trip(route: str, msg: str) -> None:
+    g_stats.count("devcheck.trip")
+    if route:
+        g_stats.count(f"devcheck.trip.{route}")
+    log.error("devcheck TRIP [%s]: %s", route or "-", msg)
+    raise DeviceCheckError(f"[{route or 'device'}] {msg}")
+
+
+def check_topk(scores, idx, n_docs: int, route: str = "",
+               use_jit: bool | None = None) -> None:
+    """Assert the emitted top-k invariants (finite, sorted, in-bounds).
+    No-op unless :func:`enabled`. Raises :class:`DeviceCheckError`."""
+    if not enabled():
+        return
+    import jax.numpy as jnp
+    jit = _use_jit() if use_jit is None else use_jit
+    err, _ = _checked("topk", jit)(
+        jnp.asarray(scores, jnp.float32),
+        jnp.asarray(idx, jnp.int32),
+        jnp.int32(n_docs))
+    msg = err.get()
+    if msg:
+        _trip(route, msg)
+
+
+def check_cube(cube, route: str = "",
+               use_jit: bool | None = None) -> None:
+    """Assert cube payload sanity (hashgroup bits decode in range).
+    No-op unless :func:`enabled`."""
+    if not enabled():
+        return
+    import jax.numpy as jnp
+    jit = _use_jit() if use_jit is None else use_jit
+    err, _ = _checked("cube", jit)(jnp.asarray(cube, jnp.uint32))
+    msg = err.get()
+    if msg:
+        _trip(route, msg)
+
+
+# --------------------------------------------------------- fault injector
+
+@contextlib.contextmanager
+def inject(kind: str):
+    """Corrupt the next checked wave: ``"nan"`` poisons a score,
+    ``"oob_docid"`` points a live row past n_docs, ``"corrupt_tile"``
+    flips a cube payload's hashgroup bits out of range. Proves the
+    checks fire (tests only; the injection happens host-side, after
+    fetch / before dispatch, so device state is never corrupted)."""
+    global _fault
+    assert kind in ("nan", "oob_docid", "corrupt_tile"), kind
+    prev = _fault
+    _fault = kind
+    try:
+        yield
+    finally:
+        _fault = prev
+
+
+def apply_fault(idx: np.ndarray, scores: np.ndarray, n_docs: int):
+    """Apply the armed output fault (if any) to one parsed wave row.
+    Returns possibly-replaced (idx, scores) copies."""
+    if _fault == "nan":
+        scores = np.asarray(scores).copy()
+        scores[0] = np.nan
+        g_stats.count("devcheck.injected")
+    elif _fault == "oob_docid":
+        idx = np.asarray(idx).copy()
+        scores = np.asarray(scores).copy()
+        idx[0] = n_docs + 7
+        scores[0] = max(float(scores[0]), 1.0)  # a LIVE row
+        g_stats.count("devcheck.injected")
+    return idx, scores
+
+
+def apply_cube_fault(cube):
+    """Apply the armed cube fault (if any): one payload with hashgroup
+    0xF (>= HASHGROUP_END) and a nonzero wordpos."""
+    if _fault != "corrupt_tile":
+        return cube
+    import jax.numpy as jnp
+    cube = jnp.asarray(cube)
+    flat = cube.reshape(-1)
+    flat = flat.at[0].set(jnp.uint32((0xF << 18) | 1))
+    g_stats.count("devcheck.injected")
+    return flat.reshape(cube.shape)
+
+
+# ----------------------------------------------------------- debug_nans
+
+@contextlib.contextmanager
+def debug_nans():
+    """Scoped ``jax_debug_nans``: every primitive re-runs un-jitted on
+    a NaN output and raises at the producing op — the heavyweight
+    companion to the checkify sweep (kernel-debugging sessions, not
+    serving)."""
+    import jax
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)

@@ -99,7 +99,7 @@ from ..index import posdb
 from ..index.collection import Collection
 from ..index.rdblite import merge_batches
 from ..utils.log import get_logger
-from . import weights
+from . import devcheck, weights
 from .compiler import SUB_SYNONYM, QueryPlan, compile_query
 from .packer import (MAX_POSITIONS, T_FLOOR, TABLE_SIZE, _bucket, _pad1,
                      group_flags, pack_payload, pad_table)
@@ -1490,6 +1490,14 @@ class DeviceIndex:
                         if kind == "f2" and f2_nsel < self.D_cap:
                             f2_next.append(i)
                             continue
+                    if devcheck.enabled():
+                        # guardrail sweep on every emitted wave row:
+                        # finite, sorted, in-bounds (devcheck docs);
+                        # apply_fault is the test-only injector
+                        idx, scores = devcheck.apply_fault(
+                            idx, scores, self.n_docs)
+                        devcheck.check_topk(scores, idx, self.n_docs,
+                                            route=kind)
                     self._emit(results, i, nm, idx, scores)
             if f1_next or f2_next:
                 self.escalations += len(f1_next) + len(f2_next)
@@ -1897,8 +1905,17 @@ class DeviceIndex:
         log.debug("fd wave: B=%d T=%d Rp=%d Lp=%d k2=%d n_sel=%d",
                   B, T, Rp, Lp, k2, n_sel)
         d_filter, d_sort, uf, us = self._filter_sort_cols(plans[0])
+        d_cube = self.d_cube
+        if devcheck.enabled():
+            # guardrail sweep over the resident position cube before
+            # the wave reads it: nonzero payloads must decode to a
+            # legal hashgroup (a corrupt/torn tile fails this with
+            # probability 5/16 per word). Host-side, pre-dispatch —
+            # _direct_cube itself is jitted so checkify can't run there
+            d_cube = devcheck.apply_cube_fault(d_cube)
+            devcheck.check_cube(d_cube, route="fd")
         return _direct_cube(
-            self.d_cube, self.d_payload, self.d_docc,
+            d_cube, self.d_payload, self.d_docc,
             self.d_siterank, self.d_doclang, self.d_dead,
             np.int32(self.n_docs), d_filter, d_sort, cs, sy, *args,
             n_positions=self.P, lpost=Lp, k2=k2,
@@ -2200,7 +2217,9 @@ def _full_cube(d_payload, d_docc, d_cube, d_dense_cnt,
             padded = jnp.concatenate(
                 [jnp.zeros((P, D), row.dtype), row], axis=0)
             row = jax.lax.dynamic_slice(
-                padded, (P - jnp.clip(c_base[r], 0, P), 0), (P, D))
+                padded,
+                (jnp.int32(P) - jnp.clip(c_base[r], 0, P)
+                 .astype(jnp.int32), jnp.int32(0)), (P, D))
             pvr = ((q[:, None] >= 0)
                    & (q[:, None]
                       < jnp.minimum(cnt, c_quota[r])[None, :])

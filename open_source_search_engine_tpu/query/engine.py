@@ -215,10 +215,15 @@ def search(coll: Collection, q: str | QueryPlan, *, topk: int = 10,
         all_docids: list[np.ndarray] = []
         all_scores: list[np.ndarray] = []
         total = 0
-        for doc_off in range(0, len(prep.cand), max_docs_per_pass):
+        # advance by pq.n_docs, not the requested stride: under memory
+        # pressure pack_pass shrinks a pass (budget_shrink) and a fixed
+        # stride would silently skip the unshrunk remainder
+        doc_off = 0
+        while doc_off < len(prep.cand):
             with g_stats.timed("query.pack"):
                 pq = pack_pass(prep, doc_offset=doc_off,
-                               max_docs=max_docs_per_pass)
+                               max_docs=max_docs_per_pass,
+                               budget_shrink=True)
             if pq is None:
                 break
             with g_stats.timed("query.score"):
@@ -226,6 +231,7 @@ def search(coll: Collection, q: str | QueryPlan, *, topk: int = 10,
             total += n_matched
             all_docids.append(docids)
             all_scores.append(scores)
+            doc_off += pq.n_docs
 
         if not all_docids:
             return SearchResults(query=raw, total_matches=0,

@@ -31,6 +31,7 @@ import numpy as np
 
 from ..index import posdb
 from ..index.collection import Collection
+from ..utils.membudget import g_membudget
 from . import weights
 from .compiler import SUB_SYNONYM, QueryPlan
 
@@ -370,9 +371,18 @@ def prepare_query(coll: Collection, plan: QueryPlan,
 
 def pack_pass(prep: PreparedQuery, doc_offset: int = 0,
               max_docs: int | None = None,
-              max_positions: int = MAX_POSITIONS) -> PackedQuery | None:
+              max_positions: int = MAX_POSITIONS,
+              budget_shrink: bool = False) -> PackedQuery | None:
     """Build the PackedQuery for one docid-range pass over the prepared
-    candidates (slice [doc_offset : doc_offset+max_docs])."""
+    candidates (slice [doc_offset : doc_offset+max_docs]).
+
+    The padded staging arrays are reserved against the process memory
+    budget under the ``pack`` label. With ``budget_shrink=True`` an
+    over-budget pass degrades by halving ``max_docs`` until it fits (or
+    one doc remains) — callers must then advance by the returned
+    ``PackedQuery.n_docs``, not their requested stride. Without it the
+    refusal is only counted and the pass proceeds (single-pass callers
+    that cannot re-slice)."""
     plan, lists = prep.plan, prep.lists
     if max_docs is not None:
         cand = prep.cand[doc_offset:doc_offset + max_docs]
@@ -432,6 +442,27 @@ def pack_pass(prep: PreparedQuery, doc_offset: int = 0,
         per_group.append((didx, payload, slot))
 
     L = _bucket(max_kept, L_FLOOR)
+    # budget gate: the padded [T,L] staging planes + [D_pad] sidecars
+    # are the pack's working set. Refused + budget_shrink ⇒ halve the
+    # doc slice and retry (the caller advances by n_docs, so nothing is
+    # skipped — just more, smaller passes).
+    est = T * L * 13 + D_pad * 13
+    granted = g_membudget.reserve("pack", est)
+    if not granted and budget_shrink and D > 1:
+        return pack_pass(prep, doc_offset, max(D // 2, 1),
+                         max_positions, budget_shrink)
+    try:
+        return _pack_arrays(prep, cand, doc_offset, per_group,
+                            required, negative, scored, counts,
+                            T, D, D_pad, L)
+    finally:
+        if granted:
+            g_membudget.release("pack", est)
+
+
+def _pack_arrays(prep, cand, doc_offset, per_group, required, negative,
+                 scored, counts, T, D, D_pad, L):
+    plan, lists = prep.plan, prep.lists
     doc_idx = np.full((T, L), D_pad, dtype=np.int32)  # D_pad = drop row
     payload = np.zeros((T, L), dtype=np.uint32)
     slot = np.zeros((T, L), dtype=np.int32)

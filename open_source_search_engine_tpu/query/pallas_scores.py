@@ -168,11 +168,29 @@ def _ms_kernel(cube_ref, fw_ref, cnt_ref, out_ref, *, T: int, P: int):
     out_ref[0] = ms
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+def _guard_cube(cube, route: str):
+    """Devcheck sweep on a concrete cube before kernel dispatch: every
+    nonzero payload must decode to a legal hashgroup. Host-side and
+    opt-in (query.devcheck); a no-op under tracing — callers already
+    inside a jit get their sweep at the devindex dispatch layer."""
+    from . import devcheck
+    if not devcheck.enabled() or isinstance(cube, jax.core.Tracer):
+        return cube
+    cube = devcheck.apply_cube_fault(cube)
+    devcheck.check_cube(cube, route=route)
+    return cube
+
+
 def min_scores_fused(cube, freqw, counts, interpret: bool = False):
     """[T, P, D] uint32 cube → min_score [D] f32 (validity = payload
     ≠ 0). ``counts`` bool [T]. Batched callers vmap this; pallas lifts
     the batch axis into the grid."""
+    cube = _guard_cube(cube, "pallas.f2")
+    return _min_scores_fused(cube, freqw, counts, interpret=interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _min_scores_fused(cube, freqw, counts, interpret: bool = False):
     from jax.experimental import pallas as pl
 
     T, P, D = cube.shape
@@ -261,13 +279,22 @@ def _fd_kernel(gq_ref, syn_ref, rows_hbm, *rest, T: int, P: int,
 
 @functools.partial(jax.jit,
                    static_argnames=("T", "P", "interpret"))
+def _fd_scores_fused(g_quarter, g_qsyn, d_cube, tail_cube, dead_i32,
+                     freqw, counts, T: int, P: int,
+                     interpret: bool = False):
+    return _fd_call(g_quarter, g_qsyn, d_cube, tail_cube, dead_i32,
+                    freqw, counts, T=T, P=P, interpret=interpret,
+                    has_tail=True)
+
+
 def fd_scores_fused(g_quarter, g_qsyn, d_cube, tail_cube, dead_i32,
                     freqw, counts, T: int, P: int,
                     interpret: bool = False):
     """Tail-carrying variant (see _fd_kernel)."""
-    return _fd_call(g_quarter, g_qsyn, d_cube, tail_cube, dead_i32,
-                    freqw, counts, T=T, P=P, interpret=interpret,
-                    has_tail=True)
+    d_cube = _guard_cube(d_cube, "pallas.fd")
+    return _fd_scores_fused(g_quarter, g_qsyn, d_cube, tail_cube,
+                            dead_i32, freqw, counts, T=T, P=P,
+                            interpret=interpret)
 
 
 @functools.partial(jax.jit,

@@ -30,9 +30,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 
 from ..index.collection import CollectionDb
-from ..query import engine
+from ..query import devcheck, engine
 from ..query.summary import highlight
 from ..utils.log import get_logger
+from ..utils.membudget import g_membudget
 from ..utils import parms as parms_mod
 from ..utils.parms import Conf
 
@@ -207,6 +208,13 @@ class SearchHTTPServer:
         gbconf = Path(base_dir) / "gb.conf"
         if conf is None and gbconf.exists():
             self.conf.load(gbconf)
+        # guardrail wiring: the process memory budget tracks the live
+        # max_mem parm (Conf::m_maxMem → g_mem), and the checkify parm
+        # arms the device-plane harness (OSSE_CHECKIFY equivalent)
+        g_membudget.set_limit(self.conf.max_mem)
+        if self.conf.checkify:
+            devcheck.set_enabled(True)
+        self.conf.on_update(self._on_guardrail_parm)
         self.stats = {"queries": 0, "injects": 0, "addurls": 0,
                       "gets": 0, "errors": 0, "auth_denied": 0}
         self._httpd: ThreadingHTTPServer | None = None
@@ -242,6 +250,16 @@ class SearchHTTPServer:
         #: per-user admin accounts (Users.cpp / users.txt)
         from ..utils.users import Users
         self.users = Users(base_dir)
+
+    def _on_guardrail_parm(self, name: str, value) -> None:
+        """Live parm updates feeding the guardrail planes (the 0x3f
+        broadcast applies here too via attach_conf → set)."""
+        if name == "max_mem":
+            g_membudget.set_limit(int(value))
+        elif name == "checkify":
+            # False reverts to the env default rather than forcing off,
+            # so OSSE_CHECKIFY=1 test runs survive a parm sync
+            devcheck.set_enabled(True if value else None)
 
     BAN_COOLDOWN_S = 60.0
 
@@ -407,6 +425,8 @@ class SearchHTTPServer:
         if path == "/admin/perf":
             from ..utils.stats import g_stats
             return 200, json.dumps(g_stats.snapshot()), "application/json"
+        if path == "/admin/mem":
+            return self._page_mem(query)
         if path == "/admin/parms":
             return self._page_parms(query)
         return 404, json.dumps({"error": "no such page"}), \
@@ -631,8 +651,8 @@ class SearchHTTPServer:
         sfx = f"?pwd={urllib.parse.quote(pwd)}" if pwd else ""
         links = "".join(
             f'<li><a href="/admin/{p}{sfx}">{p}</a></li>'
-            for p in ("stats", "hosts", "perf", "parms", "profiler",
-                      "graph"))
+            for p in ("stats", "hosts", "perf", "mem", "parms",
+                      "profiler", "graph"))
         rows = "".join(f"<tr><td>{k}</td><td>{v}</td></tr>"
                        for k, v in self.stats.items())
         colls = ", ".join(self.colldb.names())
@@ -640,6 +660,43 @@ class SearchHTTPServer:
                 f"<h1>admin</h1><p>collections: {colls}</p>"
                 f"<ul>{links}</ul><table border=1>{rows}</table>"
                 f"</body></html>")
+
+    def _page_mem(self, query: dict) -> tuple[int, str, str]:
+        """Live memory-budget breakdown (the PageStats mem table +
+        Mem.cpp printMem role): per-subsystem reserved/gauged bytes
+        against the max_mem budget, plus the guardrail counters.
+        ``?format=json`` returns the raw snapshot."""
+        from ..utils.stats import g_stats
+        snap = g_membudget.snapshot()
+        counters = g_stats.snapshot()["counters"]
+        snap["counters"] = {
+            k: v for k, v in sorted(counters.items())
+            if k.startswith(("membudget.", "devcheck."))}
+        snap["checkify"] = devcheck.enabled()
+        if query.get("format") == "json":
+            return 200, json.dumps(snap), "application/json"
+        mb = lambda n: f"{n / (1 << 20):.2f}"  # noqa: E731
+        rows = "".join(
+            f"<tr><td>{lb}</td><td>{mb(d['reserved'])}</td>"
+            f"<td>{mb(d['gauged'])}</td><td>{d['rejections']}</td></tr>"
+            for lb, d in snap["labels"].items())
+        crows = "".join(f"<tr><td>{k}</td><td>{v}</td></tr>"
+                        for k, v in snap["counters"].items()) \
+            or "<tr><td colspan=2>none</td></tr>"
+        return 200, (
+            "<html><head><title>gb mem</title></head><body>"
+            "<h1>memory budget</h1>"
+            f"<p>limit {mb(snap['limit'])} MB &middot; "
+            f"used {mb(snap['used'])} MB &middot; "
+            f"free {mb(snap['free'])} MB &middot; "
+            f"high water {mb(snap['high_water'])} MB &middot; "
+            f"rejections {snap['rejections']} &middot; "
+            f"checkify {'on' if snap['checkify'] else 'off'}</p>"
+            "<table border=1><tr><th>label</th><th>reserved MB</th>"
+            f"<th>gauged MB</th><th>rejections</th></tr>{rows}</table>"
+            f"<h2>guardrail counters</h2>"
+            f"<table border=1>{crows}</table>"
+            "</body></html>"), "text/html"
 
     def _page_profiler(self, query: dict) -> tuple[int, str, str]:
         """Per-stage timing table + on-demand SAMPLING profiler (the
@@ -725,15 +782,22 @@ class SearchHTTPServer:
             dq = self.stats["queries"] - last_q
             qps = dq / max(now - last_t, 1e-9)
             last_q, last_t = self.stats["queries"], now
-            snap = g_stats.snapshot()["latencies"].get(
-                "query.device_batch") or {}
+            full = g_stats.snapshot()
+            snap = full["latencies"].get("query.device_batch") or {}
+            # guardrail counters ride the same sample ring so PagePerf
+            # graphs budget pressure and check trips over time
+            rejects = full["counters"].get("membudget.reject", 0)
+            trips = full["counters"].get("devcheck.trip", 0)
             g_stats.sample(qps=round(qps, 2),
-                           p50_ms=round(snap.get("p50_ms", 0.0), 1))
+                           p50_ms=round(snap.get("p50_ms", 0.0), 1),
+                           budget_rejects=rejects, check_trips=trips)
             try:
                 with open(self._statsdb_path, "a",
                           encoding="utf-8") as fh:
                     fh.write(json.dumps(
-                        [time.time(), {"qps": round(qps, 2)}]) + "\n")
+                        [time.time(), {"qps": round(qps, 2),
+                                       "budget_rejects": rejects,
+                                       "check_trips": trips}]) + "\n")
                 self._lines_written += 1
                 if self._lines_written >= 512:  # it IS a ring: rotate
                     tail = self._statsdb_path.read_text(

@@ -1,0 +1,231 @@
+"""Host-plane memory-budget governor — the Mem.cpp allocation gate.
+
+Reference: the single ``gb`` binary enforces ``Conf::m_maxMem`` through
+``g_mem`` (``Mem.cpp:255``): every large allocation registers with a
+label, over-budget requests are REFUSED, and the caller degrades
+(defer the merge, dump the tree, shed the batch) instead of letting
+the kernel OOM-kill the process. This is that plane for the host side
+of the TPU port: one process-wide :class:`MemBudget` (``g_membudget``)
+keyed off the existing ``max_mem`` parm.
+
+Two accounting styles, both counted against the one limit:
+
+* **reservations** (``reserve``/``release``) — transient working sets
+  with a clear lifetime: a merge's input+output arrays, a pack pass's
+  padded device staging arrays, a build batch's concatenated key
+  images. ``reserving()`` is the context-manager form.
+* **gauges** (``set_gauge``) — long-lived structures that grow and
+  shrink in place, keyed per owner: each Rdb reports its memtable
+  bytes under the ``memtable`` label and the governor sums them.
+
+On an over-budget ``reserve`` the governor first runs registered
+**pressure handlers** (flush-the-memtable hooks — weakly referenced so
+a dead Collection never pins memory or leaks handlers), re-checks, and
+only then refuses. Every refusal bumps ``membudget.reject.<label>`` in
+``g_stats`` (statsdb surfaces it) and the caller is expected to shrink
+or defer — never to crash.
+
+The device plane's twin is ``query/devcheck.py`` (checkify harness);
+``/admin/mem`` serves :meth:`MemBudget.snapshot` live.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Callable
+
+from .log import get_logger
+from .stats import g_stats
+
+log = get_logger("membudget")
+
+#: default budget — the ``max_mem`` parm default (4 GB/instance,
+#: Conf::m_maxMem); serve wiring overwrites it from the live conf
+DEFAULT_LIMIT = 4 << 30
+
+#: the per-subsystem labels the core planes report under (free-form
+#: strings are accepted; these are the wired ones)
+LABELS = ("memtable", "merge", "pack", "docproc")
+
+
+class MemBudget:
+    """Process-wide labeled memory budget with graceful refusal."""
+
+    def __init__(self, limit: int = DEFAULT_LIMIT):
+        self._lock = threading.Lock()
+        self.limit = int(limit)
+        #: label -> reserved bytes (transient working sets)
+        self._reserved: dict[str, int] = {}
+        #: label -> {owner key -> bytes} (long-lived gauges)
+        self._gauges: dict[str, dict[object, int]] = {}
+        #: label -> refusal count (mirrors the g_stats counters)
+        self.rejections: dict[str, int] = {}
+        self.high_water = 0
+        #: weakly-held callables ``fn(need_bytes) -> freed_bytes_hint``
+        self._pressure: list[object] = []
+
+    # --- limit -----------------------------------------------------------
+
+    def set_limit(self, limit: int) -> None:
+        """Re-point the budget (the max_mem parm live-update hook)."""
+        with self._lock:
+            self.limit = max(int(limit), 1)
+
+    # --- accounting ------------------------------------------------------
+
+    def _used_locked(self) -> int:
+        return (sum(self._reserved.values())
+                + sum(sum(g.values()) for g in self._gauges.values()))
+
+    def used(self, label: str | None = None) -> int:
+        with self._lock:
+            if label is None:
+                return self._used_locked()
+            return (self._reserved.get(label, 0)
+                    + sum(self._gauges.get(label, {}).values()))
+
+    def free(self) -> int:
+        with self._lock:
+            return max(self.limit - self._used_locked(), 0)
+
+    def would_fit(self, nbytes: int) -> bool:
+        with self._lock:
+            return self._used_locked() + int(nbytes) <= self.limit
+
+    def set_gauge(self, label: str, key: object, nbytes: int) -> None:
+        """Absolute usage of one owner under a label (0 removes it).
+        ``key`` is any hashable owner identity (an Rdb's dir path)."""
+        with self._lock:
+            g = self._gauges.setdefault(label, {})
+            if nbytes <= 0:
+                g.pop(key, None)
+            else:
+                g[key] = int(nbytes)
+            self.high_water = max(self.high_water, self._used_locked())
+
+    def reserve(self, label: str, nbytes: int) -> bool:
+        """Claim ``nbytes`` under ``label``; False = over budget (after
+        pressure relief) and the caller must degrade. Zero/negative
+        requests always succeed (and claim nothing)."""
+        nbytes = int(nbytes)
+        if nbytes <= 0:
+            return True
+        with self._lock:
+            fits = self._used_locked() + nbytes <= self.limit
+        if not fits:
+            self._relieve(nbytes)
+            with self._lock:
+                fits = self._used_locked() + nbytes <= self.limit
+        if not fits:
+            with self._lock:
+                self.rejections[label] = \
+                    self.rejections.get(label, 0) + 1
+            g_stats.count("membudget.reject")
+            g_stats.count(f"membudget.reject.{label}")
+            log.warning(
+                "over budget: %s wants %d MB, %d MB free of %d MB — "
+                "refusing (caller degrades)", label, nbytes >> 20,
+                self.free() >> 20, self.limit >> 20)
+            return False
+        with self._lock:
+            self._reserved[label] = \
+                self._reserved.get(label, 0) + nbytes
+            self.high_water = max(self.high_water, self._used_locked())
+        return True
+
+    def release(self, label: str, nbytes: int) -> None:
+        nbytes = int(nbytes)
+        if nbytes <= 0:
+            return
+        with self._lock:
+            cur = self._reserved.get(label, 0)
+            self._reserved[label] = max(cur - nbytes, 0)
+
+    class _Reservation:
+        def __init__(self, budget: "MemBudget", label: str, n: int):
+            self.budget, self.label, self.n = budget, label, n
+            self.granted = False
+
+        def __enter__(self):
+            self.granted = self.budget.reserve(self.label, self.n)
+            return self.granted
+
+        def __exit__(self, *exc):
+            if self.granted:
+                self.budget.release(self.label, self.n)
+            return False
+
+    def reserving(self, label: str, nbytes: int) -> "_Reservation":
+        """``with g_membudget.reserving("merge", est) as ok:`` —
+        releases on exit when granted; ``ok`` is the grant."""
+        return MemBudget._Reservation(self, label, int(nbytes))
+
+    # --- pressure relief -------------------------------------------------
+
+    def add_pressure_handler(
+            self, fn: Callable[[int], int]) -> None:
+        """Register a memory-freeing hook run before a refusal:
+        ``fn(need_bytes) -> freed_bytes_hint``. Bound methods are held
+        through ``weakref.WeakMethod`` so registering never pins the
+        owner (a test's ShardedCollection must be collectable)."""
+        with self._lock:
+            try:
+                ref: object = weakref.WeakMethod(fn)  # bound method
+            except TypeError:
+                ref = weakref.ref(fn) if hasattr(fn, "__name__") \
+                    else (lambda: fn)
+            self._pressure.append(ref)
+
+    def _relieve(self, need: int) -> None:
+        with self._lock:
+            refs = list(self._pressure)
+        live = []
+        for ref in refs:
+            fn = ref()
+            if fn is None:
+                continue  # owner collected: drop the handler
+            live.append(ref)
+            try:
+                fn(need)
+            except Exception as e:  # noqa: BLE001 — relief best-effort
+                log.warning("pressure handler failed: %s", e)
+        with self._lock:
+            self._pressure = live
+
+    # --- introspection (/admin/mem) -------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            labels: dict[str, dict] = {}
+            for lb in sorted(set(self._reserved)
+                             | set(self._gauges)
+                             | set(self.rejections) | set(LABELS)):
+                labels[lb] = {
+                    "reserved": self._reserved.get(lb, 0),
+                    "gauged": sum(
+                        self._gauges.get(lb, {}).values()),
+                    "rejections": self.rejections.get(lb, 0),
+                }
+            used = self._used_locked()
+            return {
+                "limit": self.limit,
+                "used": used,
+                "free": max(self.limit - used, 0),
+                "high_water": self.high_water,
+                "rejections": sum(self.rejections.values()),
+                "labels": labels,
+            }
+
+    def reset(self) -> None:
+        """Drop all accounting (test isolation; the limit stays)."""
+        with self._lock:
+            self._reserved.clear()
+            self._gauges.clear()
+            self.rejections.clear()
+            self.high_water = 0
+            self._pressure = []
+
+
+#: process-wide singleton (reference ``g_mem``)
+g_membudget = MemBudget()

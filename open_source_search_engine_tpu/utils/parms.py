@@ -51,7 +51,8 @@ def _p(name, cgi, typ, default, scope, desc="", broadcast=True) -> Parm:
 PARMS: list[Parm] = [
     # --- global (Conf.h / gb.conf) ---
     _p("http_port", "hport", int, 8000, GLOBAL, "HTTP serving port (hosts.conf port column)"),
-    _p("max_mem", "maxmem", int, 4 << 30, GLOBAL, "memory budget per instance (Conf::m_maxMem, Mem.cpp:255)"),
+    _p("max_mem", "maxmem", int, 4 << 30, GLOBAL, "memory budget per instance (Conf::m_maxMem, Mem.cpp:255); enforced by utils.membudget"),
+    _p("checkify", "checkify", bool, False, GLOBAL, "on-device checkify guardrails on kernel routes (query.devcheck; OSSE_CHECKIFY=1 equivalent)"),
     _p("num_shards", "nshards", int, 1, GLOBAL, "index shards == mesh size (hosts.conf 'index-splits:')"),
     _p("num_mirrors", "nmirrors", int, 0, GLOBAL, "replicas per shard (hosts.conf 'num-mirrors:', Hostdb.cpp:336)"),
     _p("working_dir", "wdir", str, "./data", GLOBAL, "data directory (hosts.conf 'working-dir:')"),

@@ -1,0 +1,357 @@
+"""Guardrail plane: host memory budget + on-device checkify harness.
+
+Host plane (utils/membudget.py — the Mem.cpp ``g_mem``/``m_maxMem``
+gate): over-budget work degrades (defer/shrink the merge, flush the
+memtable, shed the batch) instead of OOM-killing the process, and every
+refusal is counted. Device plane (query/devcheck.py — SURVEY §5's
+checkify/debug_nans equivalent): injected NaN scores, out-of-range
+docids and corrupt cube tiles trip loud, labeled errors in BOTH eager
+("interpret") and jitted modes under JAX_PLATFORMS=cpu (conftest pins
+the backend). ``/admin/mem`` serves the live breakdown.
+"""
+
+import gc
+import json
+
+import numpy as np
+import pytest
+
+from open_source_search_engine_tpu.index import posdb, rdblite
+from open_source_search_engine_tpu.query import devcheck
+from open_source_search_engine_tpu.utils.membudget import g_membudget
+from open_source_search_engine_tpu.utils.stats import g_stats
+
+BIG = 1 << 62
+
+
+@pytest.fixture(autouse=True)
+def _fresh_guardrails():
+    """The budget governor and check flag are process singletons —
+    restore them around every test so a tiny limit can't leak."""
+    g_membudget.reset()
+    g_membudget.set_limit(BIG)
+    devcheck.set_enabled(None)
+    yield
+    g_membudget.reset()
+    g_membudget.set_limit(BIG)
+    devcheck.set_enabled(None)
+
+
+def _mk(tmp_path, **kw):
+    return rdblite.Rdb("t", tmp_path, posdb.KEY_DTYPE, **kw)
+
+
+def _keys(n, seed=0):
+    # distinct termids → no newest-wins dedup: merge preserves count
+    rng = np.random.default_rng(seed)
+    return posdb.pack(termid=np.arange(1, n + 1) + (seed << 24),
+                      docid=rng.integers(1, 1 << 30, n),
+                      wordpos=rng.integers(0, 1000, n))
+
+
+def _docs(n):
+    return [(f"http://site{i}.test/p",
+             f"<html><head><title>budget doc {i}</title></head><body>"
+             f"<p>guardrail memory governor test words {i} tpu kernels"
+             "</p></body></html>") for i in range(n)]
+
+
+# ------------------------------------------------------- host plane
+
+class TestMemBudget:
+    def test_reserve_release_and_labels(self):
+        g_membudget.set_limit(1 << 20)
+        assert g_membudget.reserve("pack", 512 << 10)
+        assert g_membudget.used("pack") == 512 << 10
+        assert not g_membudget.reserve("merge", 768 << 10)
+        snap = g_membudget.snapshot()
+        assert snap["rejections"] == 1
+        assert snap["labels"]["merge"]["rejections"] == 1
+        g_membudget.release("pack", 512 << 10)
+        assert g_membudget.used() == 0
+        assert g_membudget.snapshot()["high_water"] == 512 << 10
+
+    def test_reserving_context_releases(self):
+        g_membudget.set_limit(1 << 20)
+        with g_membudget.reserving("docproc", 1 << 18) as ok:
+            assert ok and g_membudget.used("docproc") == 1 << 18
+        assert g_membudget.used() == 0
+
+    def test_reject_counts_in_stats(self):
+        base = g_stats.snapshot()["counters"].get("membudget.reject", 0)
+        g_membudget.set_limit(16)
+        assert not g_membudget.reserve("pack", 1 << 20)
+        c = g_stats.snapshot()["counters"]
+        assert c["membudget.reject"] == base + 1
+        assert c.get("membudget.reject.pack", 0) >= 1
+
+    def test_pressure_handler_runs_and_weakref_drops(self):
+        class Owner:
+            calls = 0
+
+            def relieve(self, need):
+                Owner.calls += 1
+                g_membudget.set_limit(1 << 30)  # "free" memory
+                return 1 << 30
+
+        o = Owner()
+        g_membudget.add_pressure_handler(o.relieve)
+        g_membudget.set_limit(16)
+        assert g_membudget.reserve("merge", 1 << 20)  # relief saved it
+        assert Owner.calls == 1
+        g_membudget.release("merge", 1 << 20)
+        del o
+        gc.collect()
+        g_membudget.set_limit(16)
+        assert not g_membudget.reserve("merge", 1 << 20)
+        assert Owner.calls == 1  # dead handler was dropped, not called
+
+    def test_memtable_gauge_tracks_adds_and_dumps(self, tmp_path):
+        r = _mk(tmp_path)
+        r.add(_keys(500, seed=3))
+        assert g_membudget.used("memtable") > 0
+        r.dump()
+        assert g_membudget.used("memtable") == 0
+
+
+class TestOverBudgetMerge:
+    def test_merge_defers_then_succeeds_data_intact(self, tmp_path):
+        r = _mk(tmp_path, max_memtable_bytes=1 << 30, max_runs=99)
+        keys = _keys(3000, seed=7)
+        for a in range(0, 3000, 500):
+            r.add(keys[a:a + 500])
+            r.dump()
+        assert len(r.runs) == 6
+        ks = np.sort(keys, order=("n2", "n1", "n0"))
+
+        g_membudget.set_limit(16)  # nothing fits: merge must DEFER
+        r.attempt_merge(force=True)
+        assert len(r.runs) == 6  # deferred, process alive
+        assert g_membudget.snapshot()["rejections"] > 0
+        assert len(r.get_list(ks[0], ks[-1])) == 3000  # data intact
+
+        g_membudget.set_limit(BIG)  # pressure gone: merge proceeds
+        r.attempt_merge(force=True)
+        assert len(r.runs) == 1
+        assert len(r.get_list(ks[0], ks[-1])) == 3000
+        assert g_membudget.used("merge") == 0  # reservation released
+
+    def test_merge_shrinks_suffix_under_partial_budget(self, tmp_path):
+        r = _mk(tmp_path, max_memtable_bytes=1 << 30, max_runs=99)
+        keys = _keys(4000, seed=8)
+        for a in range(0, 4000, 500):
+            r.add(keys[a:a + 500])
+            r.dump()
+        assert len(r.runs) == 8
+        # room for a ~2-3 run merge but nowhere near all 8
+        one_run = int(r.runs[0].keys.nbytes)
+        g_membudget.set_limit(g_membudget.used() + 7 * one_run)
+        r.attempt_merge(force=True)
+        assert 1 < len(r.runs) < 8  # merged a shrunken newest suffix
+        ks = np.sort(keys, order=("n2", "n1", "n0"))
+        assert len(r.get_list(ks[0], ks[-1])) == 4000
+
+
+class TestStaleJournalTruncation:
+    def test_disabled_open_truncates_stale_journal(self, tmp_path):
+        r = _mk(tmp_path)
+        r.add(_keys(100, seed=9))  # journaled, never dumped
+        jp = r.dir / "addsinprogress.bin"
+        assert jp.stat().st_size > 0
+        del r
+        r2 = rdblite.Rdb("t", tmp_path, posdb.KEY_DTYPE, journal=False)
+        assert jp.stat().st_size == 0  # stale batches gone
+        assert len(r2.mem.batch()) == 0
+        del r2
+        # a later journal-ENABLED open must not resurrect anything
+        r3 = _mk(tmp_path)
+        assert len(r3.mem.batch()) == 0
+
+
+class TestBuildAndPackDegrade:
+    def test_index_batch_sheds_but_indexes_everything(self, tmp_path):
+        from open_source_search_engine_tpu.build import docproc
+        from open_source_search_engine_tpu.index.collection import \
+            Collection
+        coll = Collection("main", tmp_path)
+        g_membudget.set_limit(256)  # every phase-C reserve refused
+        out = docproc.index_batch(coll, _docs(12))
+        assert sum(1 for v in out if v is not None) == 12
+        assert coll.num_docs == 12
+        assert g_stats.snapshot()["counters"].get(
+            "membudget.reject.docproc", 0) > 0
+
+    def test_search_correct_under_tiny_budget(self, tmp_path):
+        from open_source_search_engine_tpu.build import docproc
+        from open_source_search_engine_tpu.index.collection import \
+            Collection
+        from open_source_search_engine_tpu.query import engine
+        coll = Collection("main", tmp_path)
+        docproc.index_batch(coll, _docs(8))
+        want = engine.search(coll, "guardrail governor", topk=8,
+                             with_snippets=False)
+        g_membudget.set_limit(1)  # pack shrinks to 1-doc passes
+        got = engine.search(coll, "guardrail governor", topk=8,
+                            with_snippets=False)
+        assert got.total_matches == want.total_matches == 8
+        assert [r.docid for r in got.results] == \
+            [r.docid for r in want.results]
+
+    def test_sharded_pressure_handler_dumps_memtables(self, tmp_path):
+        from open_source_search_engine_tpu.parallel.sharded import \
+            ShardedCollection
+        sc = ShardedCollection("main", tmp_path, n_shards=2)
+        fat = sc.grid[0][0].posdb
+        fat.add(_keys(80000, seed=11))  # ≥ 1 MB memtable
+        assert fat.mem.nbytes >= 1 << 20
+        freed = sc._relieve_memory(1)
+        assert freed >= 1 << 20
+        assert fat.mem.nbytes == 0 and len(fat.runs) == 1
+        # weakref: a collected ShardedCollection leaves no live handler
+        del sc, fat
+        gc.collect()
+        assert all(ref() is None for ref in g_membudget._pressure)
+
+
+# ----------------------------------------------------- device plane
+
+class TestDevcheckHarness:
+    @pytest.mark.parametrize("use_jit", [True, False],
+                             ids=["jit", "interpret"])
+    def test_clean_topk_passes(self, use_jit):
+        devcheck.set_enabled(True)
+        devcheck.check_topk(np.array([5.0, 3.0, 3.0, 0.0], np.float32),
+                            np.array([2, 0, 1, 0], np.int32), 4,
+                            route="f1", use_jit=use_jit)
+
+    @pytest.mark.parametrize("use_jit", [True, False],
+                             ids=["jit", "interpret"])
+    @pytest.mark.parametrize("kind,pattern", [
+        ("nan", "non-finite"),
+        ("oob_docid", "out-of-range docid"),
+    ])
+    def test_injected_fault_caught(self, use_jit, kind, pattern):
+        devcheck.set_enabled(True)
+        scores = np.array([5.0, 3.0, 1.0, 0.0], np.float32)
+        idx = np.array([2, 0, 1, 0], np.int32)
+        with devcheck.inject(kind):
+            idx, scores = devcheck.apply_fault(idx, scores, 4)
+        base = g_stats.snapshot()["counters"].get("devcheck.trip", 0)
+        with pytest.raises(devcheck.DeviceCheckError, match=pattern):
+            devcheck.check_topk(scores, idx, 4, route="f1",
+                                use_jit=use_jit)
+        c = g_stats.snapshot()["counters"]
+        assert c["devcheck.trip"] == base + 1
+        assert c.get("devcheck.trip.f1", 0) >= 1
+
+    @pytest.mark.parametrize("use_jit", [True, False],
+                             ids=["jit", "interpret"])
+    def test_monotonicity_violation_caught(self, use_jit):
+        devcheck.set_enabled(True)
+        with pytest.raises(devcheck.DeviceCheckError,
+                           match="monotonic"):
+            devcheck.check_topk(
+                np.array([3.0, 5.0, 1.0], np.float32),
+                np.array([0, 1, 2], np.int32), 3, use_jit=use_jit)
+
+    @pytest.mark.parametrize("use_jit", [True, False],
+                             ids=["jit", "interpret"])
+    def test_corrupt_tile_caught(self, use_jit):
+        devcheck.set_enabled(True)
+        cube = np.zeros((2, 4, 8), np.uint32)
+        cube[0, 0, 0] = (3 << 18) | 5  # legal payload
+        devcheck.check_cube(cube, route="fd", use_jit=use_jit)
+        with devcheck.inject("corrupt_tile"):
+            bad = devcheck.apply_cube_fault(cube)
+        with pytest.raises(devcheck.DeviceCheckError,
+                           match="corrupt position-cube tile"):
+            devcheck.check_cube(bad, route="fd", use_jit=use_jit)
+
+    def test_disabled_is_noop(self):
+        devcheck.set_enabled(None)
+        nan = np.array([np.nan], np.float32)
+        devcheck.check_topk(nan, np.array([99], np.int32), 1)  # silent
+
+
+class TestDevcheckFullRoute:
+    """Faults injected at the devindex emit hook trip on a REAL device
+    search — in eager and jitted check modes (CPU via conftest)."""
+
+    @pytest.fixture(scope="class")
+    def dev(self, tmp_path_factory):
+        from open_source_search_engine_tpu.build import docproc
+        from open_source_search_engine_tpu.index.collection import \
+            Collection
+        from open_source_search_engine_tpu.query import engine
+        tmp = tmp_path_factory.mktemp("devroute")
+        coll = Collection("main", tmp)
+        docproc.index_batch(coll, _docs(16))
+        coll.dump_all()
+        return engine.get_device_index(coll)
+
+    @pytest.mark.parametrize("interpret", ["0", "1"],
+                             ids=["jit", "interpret"])
+    @pytest.mark.parametrize("kind,pattern", [
+        ("nan", "non-finite"),
+        ("oob_docid", "out-of-range docid"),
+    ])
+    def test_search_batch_trips(self, dev, monkeypatch, interpret,
+                                kind, pattern):
+        monkeypatch.setenv("OSSE_CHECKIFY_INTERPRET", interpret)
+        devcheck.set_enabled(True)
+        with devcheck.inject(kind):
+            with pytest.raises(devcheck.DeviceCheckError,
+                               match=pattern):
+                dev.search_batch(["guardrail governor"], topk=5)
+
+    def test_clean_search_no_trip(self, dev):
+        devcheck.set_enabled(True)
+        base = g_stats.snapshot()["counters"].get("devcheck.trip", 0)
+        out = dev.search_batch(["guardrail governor", "tpu kernels"],
+                               topk=5)
+        assert all(nm > 0 for _, _, nm in out)
+        assert g_stats.snapshot()["counters"].get(
+            "devcheck.trip", 0) == base
+
+
+# ------------------------------------------------------- serve plane
+
+class TestAdminMem:
+    def test_admin_mem_live_breakdown_under_load(self, tmp_path):
+        from open_source_search_engine_tpu.build import docproc
+        from open_source_search_engine_tpu.serve.server import \
+            SearchHTTPServer
+        s = SearchHTTPServer(str(tmp_path))
+        # load: index through the server's collection — the memtable
+        # gauge and (via a forced refusal) the reject counters light up
+        coll = s.colldb.get("main")
+        docproc.index_batch(coll, _docs(6))
+        g_membudget.set_limit(16)
+        assert not g_membudget.reserve("merge", 1 << 20)
+        g_membudget.set_limit(BIG)
+
+        code, body, ctype = s.handle(
+            "GET", "/admin/mem", {"format": "json"}, b"")
+        assert code == 200 and ctype == "application/json"
+        snap = json.loads(body)
+        assert snap["used"] > 0
+        assert snap["labels"]["memtable"]["gauged"] > 0
+        assert snap["limit"] == BIG
+        assert snap["counters"].get("membudget.reject", 0) >= 1
+        # HTML flavor + admin index link
+        code, page, ctype = s.handle("GET", "/admin/mem", {}, b"")
+        assert code == 200 and "memory budget" in page
+        _, idx, _ = s.handle("GET", "/admin", {}, b"")
+        assert "/admin/mem" in idx
+
+    def test_parm_updates_flow_to_guardrails(self, tmp_path):
+        from open_source_search_engine_tpu.serve.server import \
+            SearchHTTPServer
+        s = SearchHTTPServer(str(tmp_path))
+        s.conf.max_mem = 123 << 20
+        assert g_membudget.limit == 123 << 20
+        assert not devcheck.enabled()
+        s.conf.checkify = True
+        assert devcheck.enabled()
+        s.conf.checkify = False
+        assert not devcheck.enabled()

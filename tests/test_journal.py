@@ -120,20 +120,25 @@ class TestKillMinus9ZeroLoss:
     """The VERDICT contract: kill -9 after HTTP 200 loses nothing."""
 
     def test_inject_kill9_restart(self, tmp_path):
-        port = 18934
         node_dir = str(tmp_path / "node")
+        # port 0: the OS picks a free port and the child reports it on
+        # stdout — a hardcoded port collides with parallel test runs
         code = (
             "import sys; sys.path.insert(0, %r); "
             "from open_source_search_engine_tpu.serve.server import "
             "SearchHTTPServer; "
-            "s = SearchHTTPServer(%r, port=%d); s.start(); "
+            "s = SearchHTTPServer(%r, port=0); s.start(); "
             "import time; "
-            "print('UP', flush=True); time.sleep(600)"
-            % (REPO, node_dir, port))
+            "print('UP', s.port, flush=True); time.sleep(600)"
+            % (REPO, node_dir))
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         proc = subprocess.Popen([sys.executable, "-c", code], env=env,
                                 stdout=subprocess.PIPE)
         try:
+            line = proc.stdout.readline().decode()  # blocks until UP
+            assert line.startswith("UP "), \
+                f"child died before serving: {line!r}"
+            port = int(line.split()[1])
             t0 = time.time()
             while time.time() - t0 < 90:
                 try:
