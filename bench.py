@@ -211,6 +211,102 @@ def main_mesh(n_shards: int) -> None:
     }))
 
 
+def main_transport() -> None:
+    """Transport microbench (BENCH_TRANSPORT=1): the cluster RPC plane
+    on an in-process loopback mini cluster. Reports pooled keep-alive
+    vs dial-per-request throughput (the pre-pool urlopen baseline), and
+    hedged-read tail latency against a deliberately wedged twin vs
+    riding the wedge out. Loopback/CPU numbers — the point is the
+    RELATIVE spread, not absolute RPC/s."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from open_source_search_engine_tpu.parallel import cluster as cl
+    from open_source_search_engine_tpu.parallel import transport as tr
+
+    bdir = tempfile.mkdtemp(prefix="osse_bench_transport_")
+    n_rpc = int(os.environ.get("BENCH_TRANSPORT_RPCS", "400"))
+    nodes = []
+    for i in range(2):
+        node = cl.ShardNodeServer(os.path.join(bdir, f"n{i}"))
+        for d in range(30):
+            node.handle("/rpc/index", {
+                "url": f"http://bench.test/{i}-{d}",
+                "content": (f"<html><body><p>bench words filler "
+                            f"token{d}</p></body></html>")})
+        node.start()
+        nodes.append(node)
+    addrs = [f"127.0.0.1:{n.port}" for n in nodes]
+
+    def pct(lats, q):
+        return lats[min(len(lats) - 1, int(len(lats) * q))]
+
+    def run_pings(pooled: bool):
+        lats = []
+        t = tr.Transport()
+        t0 = time.perf_counter()
+        for k in range(n_rpc):
+            if not pooled:
+                t.close()  # drop the keep-alive socket: dial per call
+            q0 = time.perf_counter()
+            t.request(addrs[k % 2], "/rpc/ping", {}, timeout=5.0)
+            lats.append(1000.0 * (time.perf_counter() - q0))
+        dt = time.perf_counter() - t0
+        t.close()
+        lats.sort()
+        return {"rpc_s": round(n_rpc / dt, 1),
+                "p50_ms": round(pct(lats, 0.50), 3),
+                "p99_ms": round(pct(lats, 0.99), 3)}
+
+    pooled = run_pings(pooled=True)
+    dialed = run_pings(pooled=False)
+
+    # hedged read racing a wedged primary vs sending only to it
+    wedge_s = 0.5
+    real_handle = nodes[0].handle
+
+    def wedged_handle(path, payload):
+        if path == "/rpc/search":
+            time.sleep(wedge_s)
+        return real_handle(path, payload)
+
+    nodes[0].handle = wedged_handle
+    payload = {"q": "bench words", "topk": 5}
+    hedge_lats, ride_lats = [], []
+    for _ in range(16):
+        # fresh transport per race: this bench PINS the wedged twin as
+        # the primary, so a carried-over EWMA (fattened by the wedge)
+        # would stretch the hedge leash — in the real client path the
+        # hostmap demotes a penalized twin from primary instead
+        t = tr.Transport()
+        q0 = time.perf_counter()
+        out, _, _ = t.hedged(addrs, "/rpc/search", payload, timeout=30.0)
+        assert out and out.get("ok")
+        hedge_lats.append(1000.0 * (time.perf_counter() - q0))
+        t.close()
+    t = tr.Transport()
+    for _ in range(4):
+        q0 = time.perf_counter()
+        t.request(addrs[0], "/rpc/search", payload, timeout=30.0)
+        ride_lats.append(1000.0 * (time.perf_counter() - q0))
+    t.close()
+    for n in nodes:
+        n.stop()
+    hedge_lats.sort()
+    ride_lats.sort()
+    print(json.dumps({
+        "metric": "transport_rpc_per_sec_pooled",
+        "value": pooled["rpc_s"], "unit": "rpc/s",
+        "vs_baseline": round(pooled["rpc_s"] / max(dialed["rpc_s"], 1e-9),
+                             2),
+        "pooled": pooled,
+        "dial_per_call": dialed,
+        "wedged_twin_ms": {
+            "wedge_ms": 1000.0 * wedge_s,
+            "hedged_p50": round(pct(hedge_lats, 0.50), 1),
+            "hedged_p99": round(pct(hedge_lats, 0.99), 1),
+            "unhedged_p50": round(pct(ride_lats, 0.50), 1)},
+    }))
+
+
 def main() -> None:
     try:
         jax = _init_backend()
@@ -488,5 +584,7 @@ def main() -> None:
 if __name__ == "__main__":
     if os.environ.get("BENCH_MESH"):
         main_mesh(int(os.environ["BENCH_MESH"]))
+    elif os.environ.get("BENCH_TRANSPORT"):
+        main_transport()
     else:
         main()

@@ -464,9 +464,15 @@ static std::string decode_entities(const char* s, size_t len,
 
 struct Attr { std::string name, val; };
 
-// parse attributes between p and end (after the tag name)
+// parse attributes between p and end (after the tag name).
+// *slash_in_val is set when the byte just before '>' was consumed as
+// part of an UNQUOTED attribute value (html.parser keeps it in the
+// value: <a href=foo/> has value "foo/" and is NOT self-closing, while
+// <a href="foo"/> and <a checked/> are) — the caller must not treat
+// that trailing '/' as a self-close marker.
 static void parse_attrs(const char* p, const char* end,
-                        std::vector<Attr>& out, bool* fallback) {
+                        std::vector<Attr>& out, bool* fallback,
+                        bool* slash_in_val) {
     while (p < end) {
         while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' ||
                            *p == '\r' || *p == '/'))
@@ -500,6 +506,8 @@ static void parse_attrs(const char* p, const char* end,
                        *q != '\n' && *q != '\r')
                     q++;
                 val = decode_entities(vs, q - vs, fallback);
+                if (q == end && q > vs && q[-1] == '/')
+                    *slash_in_val = true;
             }
             p = q;
         }
@@ -643,7 +651,13 @@ static void parse_html(Parser& P, const char* s, size_t len) {
             handle_endtag(P, tag);
         } else {
             std::vector<Attr> attrs;
-            parse_attrs(p, gt, attrs, &P.fallback);
+            bool slash_in_val = false;
+            parse_attrs(p, gt, attrs, &P.fallback, &slash_in_val);
+            // <a href=foo/> is NOT self-closing: html.parser consumes
+            // the '/' as the tail of the unquoted value — treating it
+            // as a self-close would synthesize an endtag Python never
+            // sees (and drop the anchor's text from the link harvest)
+            if (slash_in_val) selfclose = false;
             handle_starttag(P, tag, attrs);
             if (selfclose) handle_endtag(P, tag);
             // raw-content elements: skip straight to the close tag

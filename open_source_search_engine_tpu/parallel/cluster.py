@@ -23,13 +23,15 @@ in-process plane uses. Reference semantics carried over:
   node and maintains the alive matrix; recovered hosts are marked
   alive again and immediately serve.
 
-The transport is deliberately boring (JSON over loopback/LAN HTTP —
-stdlib only): the *semantics* are the work, and the reference itself
-treats its UDP layer as a replaceable courier. Scatter-gather queries
-(the Msg3a merge) run the per-shard execution in parallel threads and
-merge top-k host-side; inside each node the query still runs on the
-TPU-resident two-phase kernel, so ICI does the per-shard heavy lifting
-and this plane is the DCN/control story.
+The courier is :mod:`.transport` (stdlib HTTP, but no longer boring):
+pooled keep-alive connections per host, hedged twin reads with RTT
+EWMAs, per-shard query batching, and a negotiated binary codec for the
+bulk routes — the ``UdpServer.cpp``/``Multicast.cpp`` roles over HTTP.
+The *semantics* here stay the work: scatter-gather queries (the Msg3a
+merge) run the per-shard execution in parallel and merge top-k
+host-side; inside each node the query still runs on the TPU-resident
+two-phase kernel, so ICI does the per-shard heavy lifting and this
+plane is the DCN/control story.
 """
 
 from __future__ import annotations
@@ -38,8 +40,6 @@ import json
 import os
 import threading
 import time
-import urllib.error
-import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -50,7 +50,10 @@ import numpy as np
 from ..index.collection import Collection
 from ..utils import ghash
 from ..utils.log import get_logger
+from ..utils.stats import g_stats
+from . import transport as transport_mod
 from .hostmap import HostMap
+from .transport import BIN_CONTENT_TYPE, RpcError, Transport, as_array
 
 log = get_logger("cluster")
 
@@ -134,6 +137,15 @@ class ShardNodeServer:
         self.use_device = use_device
         self._httpd: ThreadingHTTPServer | None = None
         self._lock = threading.RLock()  # single-writer core
+        #: TCP connections accepted since start — with a pooled client
+        #: this stays ~1 per peer; it climbing with request count means
+        #: keep-alive broke somewhere
+        self.accepts = 0
+        self._accept_lock = threading.Lock()
+        #: live accepted sockets: stop() must sever them, or a handler
+        #: thread parked on a keep-alive connection outlives the
+        #: "stopped" server and keeps answering for a dead node
+        self._conns: set = set()
         #: background RPCs (X-Niceness: 1 — spider writes, heal pulls)
         #: yield to in-flight interactive reads at the door, BEFORE
         #: contending for the writer lock (UdpProtocol.h niceness bit)
@@ -190,7 +202,8 @@ class ShardNodeServer:
 
         if path == "/rpc/ping":
             # lock-free: a long write/checkpoint must not fail heartbeats
-            return {"ok": True, "docs": self.coll.num_docs}
+            return {"ok": True, "docs": self.coll.num_docs,
+                    "accepts": self.accepts}
         if path == "/rpc/conf":
             # read-only conf dump (ops + broadcast verification)
             return {"ok": True, "conf": self.coll.conf.to_dict()}
@@ -225,11 +238,37 @@ class ShardNodeServer:
                 ok = docproc.remove_document(self.coll, payload["url"])
                 return {"ok": bool(ok)}
             if path == "/rpc/search":
+                topk = int(payload.get("topk", 10))
+                lang = int(payload.get("lang", 0))
+                if "queries" in payload:
+                    # batched scatter-gather: the client coalesces
+                    # concurrent callers per shard; one device dispatch
+                    # (search_device_batch vmaps the whole batch)
+                    # instead of a request per query
+                    qs = [str(q) for q in payload["queries"]]
+                    if self.use_device:
+                        many = engine.search_device_batch(
+                            self.coll, qs, topk=topk, lang=lang,
+                            with_snippets=False, site_cluster=False)
+                    else:
+                        many = [engine.search(
+                            self.coll, q, topk=topk, lang=lang,
+                            with_snippets=False, site_cluster=False)
+                            for q in qs]
+                    g_stats.count("transport.node_batched_q", len(qs))
+                    return {"ok": True, "results": [
+                        {"total": r.total_matches,
+                         "docids": np.asarray(
+                             [int(x.docid) for x in r.results],
+                             dtype=np.int64),
+                         "scores": np.asarray(
+                             [float(x.score) for x in r.results],
+                             dtype=np.float64)}
+                        for r in many]}
                 search = (engine.search_device if self.use_device
                           else engine.search)
-                res = search(self.coll, payload["q"],
-                             topk=int(payload.get("topk", 10)),
-                             lang=int(payload.get("lang", 0)),
+                res = search(self.coll, payload["q"], topk=topk,
+                             lang=lang,
                              with_snippets=False, site_cluster=False)
                 return {
                     "ok": True,
@@ -396,6 +435,32 @@ class ShardNodeServer:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            # keep-alive is the whole point of the client's connection
+            # pool, and HTTP/1.0 (the BaseHTTPRequestHandler default)
+            # closes after every response — 1.1 + the explicit
+            # Content-Length below keeps the socket open
+            protocol_version = "HTTP/1.1"
+            # headers and body go out as two writes; with Nagle on, the
+            # body write stalls on the peer's delayed ACK (~40 ms) on
+            # every KEEP-ALIVE request — fresh dials dodge it via
+            # quick-ack, which would make the pool look slower than
+            # dial-per-call
+            disable_nagle_algorithm = True
+
+            def setup(self):
+                super().setup()
+                # one setup() per ACCEPTED connection (many requests
+                # ride each under keep-alive) — the pool-effectiveness
+                # signal surfaced via /rpc/ping
+                with outer._accept_lock:
+                    outer.accepts += 1
+                    outer._conns.add(self.connection)
+
+            def finish(self):
+                with outer._accept_lock:
+                    outer._conns.discard(self.connection)
+                super().finish()
+
             def log_message(self, fmt, *args):
                 log.debug("%s " + fmt, self.client_address[0], *args)
 
@@ -406,9 +471,12 @@ class ShardNodeServer:
                     nice = int(self.headers.get("X-Niceness") or 0)
                 except ValueError:
                     nice = 0
+                accept_bin = BIN_CONTENT_TYPE in (
+                    self.headers.get("Accept") or "")
                 outer.nice_gate.enter(nice)
                 try:
-                    payload = json.loads(body or b"{}")
+                    payload = transport_mod.decode_body(
+                        body, self.headers.get("Content-Type", ""))
                     out = outer.handle(self.path, payload)
                     code = 200
                 except KeyError:
@@ -417,9 +485,13 @@ class ShardNodeServer:
                     out, code = {"error": str(e)}, 500
                 finally:
                     outer.nice_gate.exit(nice)
-                data = json.dumps(out).encode()
+                # reply codec: binary only when the peer advertised it
+                # (old clients never do → JSON wire, unchanged bytes);
+                # errors stay JSON so any peer can read them
+                data, ctype = transport_mod.encode_body(
+                    out, accept_bin and code == 200)
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
@@ -438,6 +510,22 @@ class ShardNodeServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        # sever live keep-alive connections: their handler threads
+        # would otherwise keep serving this "stopped" node (a process
+        # kill severs them for free; in-process stop must match)
+        with self._accept_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for c in conns:
+            try:
+                import socket as _socket
+                c.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
 
 # ---------------------------------------------------------------------------
@@ -445,44 +533,36 @@ class ShardNodeServer:
 # ---------------------------------------------------------------------------
 
 def _encode_batch(batch) -> dict:
-    """RecordBatch → JSON-safe dict (base64 .npy images). The twin
-    patch ships whole Rdbs; base64-over-JSON costs 33% wire overhead —
-    acceptable for a repair path that runs on corruption, not queries."""
-    import base64
-    import io
+    """RecordBatch → wire dict of raw ndarrays. The transport layer
+    picks the codec per peer: length-prefixed raw frames on the binary
+    wire, base64 ``.npy`` strings on the JSON fallback (byte-compatible
+    with the pre-pool wire, so old clients keep decoding)."""
     out = {}
-    for nm, arr in (("keys", np.ascontiguousarray(batch.keys)),
-                    ("offsets", batch.offsets), ("data", batch.data)):
+    for nm, arr in (("keys", batch.keys), ("offsets", batch.offsets),
+                    ("data", batch.data)):
         if arr is None:
             continue
-        bio = io.BytesIO()
-        np.save(bio, np.ascontiguousarray(arr))
-        out[nm] = base64.b64encode(bio.getvalue()).decode()
+        out[nm] = np.ascontiguousarray(arr)
     return out
 
 
 def _decode_batch(d: dict):
-    import base64
-    import io
-
+    """Wire dict (raw ndarrays OR base64 .npy strings) → RecordBatch."""
     from ..index.rdblite import RecordBatch
-    arrs = {nm: np.load(io.BytesIO(base64.b64decode(v)))
-            for nm, v in d.items()}
+    arrs = {nm: as_array(v) for nm, v in d.items()}
     return RecordBatch(arrs["keys"], arrs.get("offsets"),
                        arrs.get("data"))
 
 
 def _rpc(addr: str, path: str, payload: dict,
          timeout: float = RPC_TIMEOUT_S, niceness: int = 0) -> dict:
-    """One JSON RPC. ``niceness`` rides an X-Niceness header (the
-    UdpProtocol.h niceness bit): 1 = background traffic the receiving
-    node may hold while interactive requests are in flight."""
-    req = urllib.request.Request(
-        f"http://{addr}{path}", data=json.dumps(payload).encode(),
-        headers={"Content-Type": "application/json",
-                 "X-Niceness": str(niceness)}, method="POST")
-    with urllib.request.urlopen(req, timeout=timeout) as r:
-        return json.load(r)
+    """One RPC over the process-wide pooled transport. ``niceness``
+    rides an X-Niceness header (the UdpProtocol.h niceness bit): 1 =
+    background traffic the receiving node may hold while interactive
+    requests are in flight."""
+    return transport_mod.g_transport.request(addr, path, payload,
+                                             timeout=timeout,
+                                             niceness=niceness)
 
 
 @dataclass
@@ -515,22 +595,109 @@ class _HostQueue:
             return len(self.items)
 
 
+class _ShardSearchBatcher:
+    """Per-shard query coalescing — the cluster-plane analog of the
+    serving side's ``QueryBatcher``: concurrent callers hitting the
+    same shard within one batching window ride ONE ``/rpc/search``
+    carrying a query list, which the node executes as a single
+    ``search_device_batch`` dispatch. On loopback the window is ~2 ms;
+    across DCN it is hidden entirely inside the shard RTT."""
+
+    WINDOW_S = 0.002
+    MAX_B = 64
+
+    def __init__(self, client: "ClusterClient", shard: int):
+        self.client = client
+        self.shard = shard
+        self._cv = threading.Condition()
+        #: (key, query, holder) — key groups compatible requests
+        self._queue: list[tuple] = []
+        self._thread: threading.Thread | None = None
+
+    def submit(self, q: str, topk: int, lang: int,
+               timeout: float) -> dict | None:
+        holder = {"done": False, "out": None}
+        with self._cv:
+            self._queue.append(((topk, lang), q, holder))
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True,
+                    name=f"shard{self.shard}-qbatch")
+                self._thread.start()
+            self._cv.notify_all()
+        deadline = time.monotonic() + timeout + 5.0
+        with self._cv:
+            while not holder["done"]:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cv.wait(left)
+        return holder["out"]
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                if not self._queue:
+                    self._cv.wait(timeout=5.0)
+                    if not self._queue:
+                        self._thread = None
+                        return  # idle — next submit restarts us
+            time.sleep(self.WINDOW_S)  # let concurrent callers pile in
+            with self._cv:
+                key = self._queue[0][0]
+                batch = [e for e in self._queue if e[0] == key]
+                batch = batch[: self.MAX_B]
+                for e in batch:
+                    self._queue.remove(e)
+            try:
+                self._issue(key, batch)
+            except Exception as e:  # noqa: BLE001 — keep the lane alive
+                log.warning("shard %d batch failed: %s", self.shard, e)
+                with self._cv:
+                    for _, _, holder in batch:
+                        holder["done"] = True
+                    self._cv.notify_all()
+
+    def _issue(self, key: tuple, batch: list) -> None:
+        topk, lang = key
+        qs = [q for _, q, _ in batch]
+        out = self.client._read_shard(
+            self.shard, "/rpc/search",
+            {"queries": qs, "topk": topk, "lang": lang},
+            timeout=SEARCH_TIMEOUT_S)
+        results = out.get("results") if out else None
+        if not isinstance(results, list) or len(results) != len(qs):
+            # old node (no batch support → 404 on "queries") or a
+            # malformed reply: legacy single-query wire, one per entry
+            g_stats.count("transport.batch_fallback")
+            results = [self.client._read_shard(
+                self.shard, "/rpc/search",
+                {"q": q, "topk": topk, "lang": lang},
+                timeout=SEARCH_TIMEOUT_S) for q in qs]
+        with self._cv:
+            for (_, _, holder), res in zip(batch, results):
+                holder["out"] = res
+                holder["done"] = True
+            self._cv.notify_all()
+
+
 class ClusterClient:
     """Routes adds/reads/queries across the node processes."""
 
     def __init__(self, conf: HostsConf, use_heartbeat: bool = True,
-                 parms=None):
+                 parms=None, transport: Transport | None = None):
         self.conf = conf
         #: optional global Conf (utils.parms) — supplies alert_cmd etc.
         self.parms = parms
+        #: pooled/hedged courier — own instance so tests can isolate
+        #: pools, but any Transport (e.g. a JSON-only one) drops in
+        self.transport = transport or Transport()
         self.hostmap = HostMap(conf.n_shards, conf.n_replicas)
         self._queues = {(s, r): _HostQueue()
                         for s in range(conf.n_shards)
                         for r in range(conf.n_replicas)}
-        #: per-twin read-latency EWMA — the request-load-balancing
-        #: signal (least-loaded twin serves reads)
-        self._read_ewma = [[0.0] * conf.n_replicas
-                           for _ in range(conf.n_shards)]
+        self._batchers = {s: _ShardSearchBatcher(self, s)
+                          for s in range(conf.n_shards)}
         #: 0x3f broadcast sequencer (this client == the host0 role).
         #: Seeded from the wall clock so a RESTARTED host0 client's
         #: sequence numbers stay above everything the nodes have seen
@@ -543,7 +710,7 @@ class ClusterClient:
         #: reads get their own pool: a wedged twin blocking long search
         #: reads must not starve write delivery of workers
         self._read_pool = ThreadPoolExecutor(
-            max_workers=max(8, 2 * conf.n_shards * conf.n_replicas))
+            max_workers=max(16, 4 * conf.n_shards * conf.n_replicas))
         self._retry_thread = threading.Thread(
             target=self._retry_loop, daemon=True, name="msg1-retry")
         self._retry_thread.start()
@@ -557,6 +724,7 @@ class ClusterClient:
     def close(self) -> None:
         self._stop.set()
         self._pool.shutdown(wait=False)
+        self.transport.close()
 
     @property
     def pending_writes(self) -> int:
@@ -566,8 +734,9 @@ class ClusterClient:
 
     def _ping(self, shard: int, replica: int) -> bool:
         try:
-            out = _rpc(self.conf.addresses[shard][replica], "/rpc/ping",
-                       {}, timeout=PING_TIMEOUT_S)
+            out = self.transport.request(
+                self.conf.addresses[shard][replica], "/rpc/ping", {},
+                timeout=PING_TIMEOUT_S)
             return bool(out.get("ok"))
         except Exception:  # noqa: BLE001
             return False
@@ -623,9 +792,11 @@ class ClusterClient:
         try:
             # writes are background traffic (reference Msg4 adds run at
             # niceness 1): the receiving node lets interactive queries
-            # go first
-            out = _rpc(self.conf.addresses[p.shard][p.replica], p.path,
-                       p.payload, niceness=1)
+            # go first. NEVER hedged: writes are not idempotent at the
+            # ordered-queue layer — one delivery path per twin.
+            out = self.transport.request(
+                self.conf.addresses[p.shard][p.replica], p.path,
+                p.payload, timeout=RPC_TIMEOUT_S, niceness=1)
             return bool(out.get("ok"))
         except Exception as e:  # noqa: BLE001
             log.debug("deliver to %d/%d failed: %s", p.shard, p.replica, e)
@@ -745,41 +916,49 @@ class ClusterClient:
 
     def _read_shard(self, shard: int, path: str, payload: dict,
                     timeout: float = RPC_TIMEOUT_S) -> dict | None:
-        """Try twins in (liveness, least-observed-latency) order; mark
-        failures dead and reroute (Multicast.cpp:520 — the reference
-        likewise prefers the less-loaded twin via its ping/load info).
-        None = whole shard down. The EWMA of per-read latency is the
-        load signal: a twin bogged down by a merge or a heal answers
-        slower and organically sheds read traffic to its sibling.
+        """Hedged twin read: the primary goes to the currently-fastest
+        live twin (Multicast.cpp:520 pickBestHost — alive first, then
+        lowest RTT EWMA); if it fails outright the next twin launches
+        immediately, and if it merely dawdles past the hedge delay the
+        SAME request races on the other twin and the first good answer
+        wins (Dean & Barroso hedged requests). None = whole shard down.
 
         A failed read dead-marks the host only when a follow-up ping
         ALSO fails — one slow deep-paging query must not take a
         healthy twin out of rotation (the reference distinguishes
         request timeout from host death the same way: PingServer owns
-        liveness, Multicast only reroutes)."""
-        order = sorted(
-            range(self.conf.n_replicas),
-            key=lambda r: (not self.hostmap.alive[shard, r],
-                           self._read_ewma[shard][r]))
-        for r in order:
-            t0 = time.monotonic()
-            try:
-                out = _rpc(self.conf.addresses[shard][r], path,
-                           payload, timeout=timeout)
-                if out.get("ok") or "total" in out:
-                    self.hostmap.mark_alive(shard, r)
-                    dt = time.monotonic() - t0
-                    self._read_ewma[shard][r] = (
-                        0.8 * self._read_ewma[shard][r] + 0.2 * dt)
-                    return out
-            except Exception:  # noqa: BLE001
-                if self._ping(shard, r):
-                    # alive but slow/failed on this request: penalize
-                    # its load signal, try the twin, keep it alive
-                    self._read_ewma[shard][r] += 1.0
-                else:
-                    self.hostmap.mark_dead(shard, r)
-        return None
+        liveness, Multicast only reroutes). A twin that completed with
+        a mere not-ok answer is healthy by construction — no ping, no
+        penalty."""
+        order = self.hostmap.twin_order(shard)
+        addrs = [self.conf.addresses[shard][r] for r in order]
+        t0 = time.monotonic()
+        out, winner, failures = self.transport.hedged(
+            addrs, path, payload, timeout=timeout)
+        for i, err in failures:
+            r = order[i]
+            if isinstance(err, transport_mod.NotOkError):
+                continue
+            if self._ping(shard, r):
+                # alive but slow/failed on this request: penalize its
+                # load signal, keep it alive
+                self.hostmap.penalize(shard, r, 1.0)
+            else:
+                self.hostmap.mark_dead(shard, r)
+        if out is None:
+            return None
+        r = order[winner]
+        self.hostmap.mark_alive(shard, r)
+        self.hostmap.observe_rtt(shard, r, time.monotonic() - t0)
+        # a twin still wedged in flight when the hedge won gets its
+        # load signal bumped inside Transport.hedged (the abandoned
+        # request never reports a latency sample) — mirror that into
+        # the hostmap twin ordering
+        for i in range(winner):
+            if all(f[0] != i for f in failures):
+                self.hostmap.penalize(shard, order[i],
+                                      time.monotonic() - t0)
+        return out
 
     def get_document(self, docid: int) -> dict | None:
         shard = int(self.hostmap.shard_of_docid(docid))
@@ -787,6 +966,34 @@ class ClusterClient:
         return out.get("doc") if out else None
 
     # --- scatter-gather query (Msg3a) ------------------------------------
+
+    def _search_shard(self, shard: int, q: str, topk: int,
+                      lang: int) -> dict | None:
+        """One shard's leg of the scatter: rides the per-shard batcher
+        so concurrent queries coalesce into one (hedged) RPC."""
+        return self._batchers[shard].submit(q, topk, lang,
+                                            SEARCH_TIMEOUT_S)
+
+    def search_batch(self, queries: list[str], topk: int = 10,
+                     lang: int = 0, with_snippets: bool = True,
+                     site_cluster: bool = True, offset: int = 0,
+                     conf=None) -> list:
+        """Many queries, answered concurrently: each runs the normal
+        scatter-gather merge, but their per-shard legs coalesce in the
+        shard batchers into batched ``/rpc/search`` RPCs — one
+        ``search_device_batch`` dispatch per shard per window instead
+        of one RPC per (query, shard). Results come back in input
+        order."""
+        if not queries:
+            return []
+        with ThreadPoolExecutor(
+                max_workers=min(32, len(queries))) as ex:
+            futs = [ex.submit(self.search, q, topk=topk, lang=lang,
+                              with_snippets=with_snippets,
+                              site_cluster=site_cluster,
+                              offset=offset, conf=conf)
+                    for q in queries]
+            return [f.result() for f in futs]
 
     def search(self, q: str, topk: int = 10, lang: int = 0,
                with_snippets: bool = True, site_cluster: bool = True,
@@ -800,8 +1007,7 @@ class ClusterClient:
         want = max(topk + offset, PQR_SCAN)
         over = max(want * 2, 16)
         futs = [self._read_pool.submit(
-            self._read_shard, s, "/rpc/search",
-            {"q": q, "topk": over, "lang": lang}, SEARCH_TIMEOUT_S)
+            self._search_shard, s, q, over, lang)
             for s in range(self.conf.n_shards)]
         total = 0
         docids: list[int] = []
@@ -819,8 +1025,9 @@ class ClusterClient:
                 degraded = True  # whole shard down: partial answer
                 continue
             total += int(out.get("total", 0))
-            docids += out.get("docids", [])
-            scores += out.get("scores", [])
+            docids += [int(x) for x in as_array(out.get("docids", []))]
+            scores += [float(x)
+                       for x in as_array(out.get("scores", []))]
         order = np.argsort(-np.asarray(scores, dtype=np.float64),
                            kind="stable")
         plan = compile_query(q, lang=lang)

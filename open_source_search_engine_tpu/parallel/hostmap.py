@@ -62,11 +62,19 @@ class HostMap:
     n_shards: int
     n_replicas: int = 1
     alive: np.ndarray = field(default=None)  # bool [n_shards, n_replicas]
+    #: per-twin read-RTT EWMA seconds — the ``pickBestHost`` load
+    #: signal (``Multicast.cpp:520`` prefers the less-loaded twin from
+    #: its ping/load info; here a twin bogged down by a merge or heal
+    #: answers slower and organically sheds read traffic)
+    rtt_s: np.ndarray = field(default=None)  # float [n_shards, n_replicas]
 
     def __post_init__(self):
         if self.alive is None:
             self.alive = np.ones((self.n_shards, self.n_replicas),
                                  dtype=bool)
+        if self.rtt_s is None:
+            self.rtt_s = np.zeros((self.n_shards, self.n_replicas),
+                                  dtype=np.float64)
 
     def shard_of_docid(self, docid) -> np.ndarray:
         return posdb.shard_of_docid(docid, self.n_shards)
@@ -102,3 +110,24 @@ class HostMap:
             if self.alive[shard, r]:
                 return r
         return None
+
+    def observe_rtt(self, shard: int, replica: int, dt_s: float) -> None:
+        """Fold one completed read's latency into the twin's EWMA."""
+        prev = self.rtt_s[shard, replica]
+        self.rtt_s[shard, replica] = (dt_s if prev == 0.0
+                                      else 0.8 * prev + 0.2 * dt_s)
+
+    def penalize(self, shard: int, replica: int,
+                 dt_s: float = 1.0) -> None:
+        """Degrade a twin's load signal without a completed read (it
+        failed a request or sat on one past the hedge delay) — slow is
+        not dead, but it should stop being the primary."""
+        self.rtt_s[shard, replica] += dt_s
+
+    def twin_order(self, shard: int) -> list[int]:
+        """Replicas of a shard in read-preference order: alive first,
+        then fastest observed — the hedged read launches down this
+        list."""
+        return sorted(range(self.n_replicas),
+                      key=lambda r: (not self.alive[shard, r],
+                                     float(self.rtt_s[shard, r])))

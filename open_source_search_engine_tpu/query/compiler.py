@@ -114,7 +114,8 @@ class TermGroup:
         return [s.termid for s in self.sublists]
 
     def slot_plan(self, max_positions: int = 16,
-                  present: list[bool] | None = None
+                  present: list[bool] | None = None,
+                  df: list[int] | None = None
                   ) -> list[tuple[int, int]]:
         """[(slot_base, quota)] per sublist: the ORIGINAL word keeps at
         least half the position budget; bigram/synonym variants split
@@ -125,8 +126,17 @@ class TermGroup:
         variants get quota 0 instead of reserving dead slots, so a word
         whose synonyms don't occur in the corpus keeps the FULL position
         budget (the reference's mini-merge has no such reservation —
-        slots are a packing artifact here). Callers on every path (host
-        packer, device planner) pass the same mask, so parity holds."""
+        slots are a packing artifact here). ``df`` (per-sublist live
+        document frequency, parallel to ``sublists``) decides WHICH
+        variants win the funded quarter-slots: highest df first — a
+        rare conjugate must not burn a slot while a common dictionary
+        synonym that actually matches documents gets silently dropped
+        (the recall regression is invisible otherwise, so the drop
+        count lands in ``query.variants_dropped``). Without ``df`` the
+        funding falls back to sublist order (conjugates attach before
+        dictionary synonyms). Callers on every path (host packer,
+        device planner) pass the same mask AND the same dfs, so parity
+        holds."""
         subs = self.sublists
         if present is None:
             present = [True] * len(subs)
@@ -142,21 +152,29 @@ class TermGroup:
         # would silently disqualify common synonym-bearing queries from
         # the FD fast path. Variants past the slot budget get quota 0
         # (the reference's mini-merge buffers cap sublists the same
-        # way, MAX_SUBLISTS); conjugates attach before dictionary
-        # synonyms, so the morphological forms win the slots.
+        # way, MAX_SUBLISTS).
         var = max(max_positions // 4, 1)
         budget = max_positions - prim
+        variant_idx = [i for i, (s, p) in enumerate(zip(subs, present))
+                       if p and s.kind != SUB_ORIGINAL]
+        n_funded = min(len(variant_idx), budget // var)
+        ranked = variant_idx if df is None else \
+            sorted(variant_idx, key=lambda i: (-int(df[i]), i))
+        funded = set(ranked[:n_funded])
+        dropped = len(variant_idx) - n_funded
+        if dropped > 0:
+            from ..utils.stats import g_stats
+            g_stats.count("query.variants_dropped", dropped)
         out = []
         base = 0
-        for s, p in zip(subs, present):
+        for i, (s, p) in enumerate(zip(subs, present)):
             if not p:
                 out.append((min(base, max_positions - 1), 0))
                 continue
             if s.kind == SUB_ORIGINAL:
                 q = prim
-            elif budget >= var:
+            elif i in funded:
                 q = var
-                budget -= var
             else:
                 q = 0
             out.append((min(base, max_positions - 1), q))

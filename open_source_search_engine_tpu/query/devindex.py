@@ -1147,10 +1147,20 @@ class DeviceIndex:
             # runs in the directory, but its merged host list is empty;
             # diverging masks would give the two paths different slot
             # plans and break parity)
+            sub_live_df = [self._df_of(s.termid) for s in subs]
             sp = g.slot_plan(
                 self.P,
-                present=[bool(d) and self._df_of(s.termid) > 0
-                         for s, d in zip(subs, sub_druns)])
+                present=[bool(d) and ldf > 0
+                         for d, ldf in zip(sub_druns, sub_live_df)],
+                # LOCAL live dfs for variant funding on both paths: the
+                # host packer passes its fetched-list distinct-doc
+                # counts, which equal _df_of under tombstones — the
+                # funded-variant pick (and so the packed layout) stays
+                # bit-identical across host and device planners. The
+                # cluster-wide df_of override stays out of this on
+                # purpose: it would diverge from what the host path can
+                # compute locally.
+                df=sub_live_df)
             any_postings = False
             gdf = 0
             g_runs = []

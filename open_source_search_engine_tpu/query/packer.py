@@ -149,6 +149,11 @@ class GroupList:
     #: across paths; a synonym sublist must not inflate the main term's
     #: document frequency)
     group_df: int = 0
+    #: per-sublist distinct-doc counts, aligned with the group's
+    #: sublists (0 = no postings) — feeds slot_plan's df-ordered
+    #: variant funding; the device planner derives the same numbers
+    #: from _df_of, so the two paths pick identical funded variants
+    sub_df: np.ndarray | None = None
 
 
 def fetch_group_lists(coll: Collection, plan: QueryPlan) -> list[GroupList]:
@@ -158,6 +163,7 @@ def fetch_group_lists(coll: Collection, plan: QueryPlan) -> list[GroupList]:
         cols = {"docids": [], "payload": [], "siterank": [], "langid": [],
                 "sub": []}
         sub_dfs = [0]
+        per_sub_df = np.zeros(max(len(g.sublists), 1), np.int64)
         for s_i, sub in enumerate(g.sublists):
             batch = coll.termlist_cache.get(sub.termid,
                                             coll.posdb.version)
@@ -175,6 +181,7 @@ def fetch_group_lists(coll: Collection, plan: QueryPlan) -> list[GroupList]:
             # term), so the distinct-doc count is a boundary count
             d_ = f["docid"]
             sub_dfs.append(int((d_[1:] != d_[:-1]).sum()) + 1)
+            per_sub_df[s_i] = sub_dfs[-1]
             cols["docids"].append(f["docid"])
             cols["payload"].append(payload)
             cols["siterank"].append(f["siterank"].astype(np.int32))
@@ -193,7 +200,8 @@ def fetch_group_lists(coll: Collection, plan: QueryPlan) -> list[GroupList]:
                 langid=np.concatenate(cols["langid"])[order],
                 sub=np.concatenate(cols["sub"])[order],
                 n_subs=max(len(g.sublists), 1),
-                group_df=max(sub_dfs)))
+                group_df=max(sub_dfs),
+                sub_df=per_sub_df))
         else:
             out.append(GroupList(
                 docids=np.empty(0, np.uint64),
@@ -201,7 +209,8 @@ def fetch_group_lists(coll: Collection, plan: QueryPlan) -> list[GroupList]:
                 siterank=np.empty(0, np.int32),
                 langid=np.empty(0, np.int32),
                 sub=np.empty(0, np.int32),
-                n_subs=max(len(g.sublists), 1)))
+                n_subs=max(len(g.sublists), 1),
+                sub_df=per_sub_df))
     return out
 
 
@@ -424,8 +433,10 @@ def pack_pass(prep: PreparedQuery, doc_offset: int = 0,
             n_subs = len(plan.groups[g_i].sublists)
             have = np.zeros(n_subs, bool)
             have[np.unique(gl.sub)] = True
-            sp = plan.groups[g_i].slot_plan(max_positions,
-                                            present=list(have))
+            sp = plan.groups[g_i].slot_plan(
+                max_positions, present=list(have),
+                df=None if gl.sub_df is None
+                else [int(x) for x in gl.sub_df])
             bases = np.array([b for b, _ in sp], np.int32)
             quotas = np.array([q for _, q in sp], np.int32)
             n = len(didx)

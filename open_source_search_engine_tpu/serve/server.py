@@ -427,6 +427,8 @@ class SearchHTTPServer:
             return 200, json.dumps(g_stats.snapshot()), "application/json"
         if path == "/admin/mem":
             return self._page_mem(query)
+        if path == "/admin/transport":
+            return self._page_transport(query)
         if path == "/admin/parms":
             return self._page_parms(query)
         return 404, json.dumps({"error": "no such page"}), \
@@ -651,8 +653,8 @@ class SearchHTTPServer:
         sfx = f"?pwd={urllib.parse.quote(pwd)}" if pwd else ""
         links = "".join(
             f'<li><a href="/admin/{p}{sfx}">{p}</a></li>'
-            for p in ("stats", "hosts", "perf", "mem", "parms",
-                      "profiler", "graph"))
+            for p in ("stats", "hosts", "perf", "mem", "transport",
+                      "parms", "profiler", "graph"))
         rows = "".join(f"<tr><td>{k}</td><td>{v}</td></tr>"
                        for k, v in self.stats.items())
         colls = ", ".join(self.colldb.names())
@@ -697,6 +699,41 @@ class SearchHTTPServer:
             f"<h2>guardrail counters</h2>"
             f"<table border=1>{crows}</table>"
             "</body></html>"), "text/html"
+
+    def _page_transport(self, query: dict) -> tuple[int, str, str]:
+        """Cluster transport health (the PagePerf slice of the
+        Multicast/UdpServer role): per-peer connection pool + RTT
+        EWMAs, hedge fired/won counters, connection reuse/dial/retry
+        counts, and the hostmap's twin-preference state. JSON, like
+        /admin/hosts and /admin/perf."""
+        from ..parallel.transport import g_transport
+        from ..utils.stats import g_stats
+        snap = g_stats.snapshot()
+        body = {
+            "counters": {k: v for k, v in sorted(
+                snap["counters"].items())
+                if k.startswith("transport.")},
+            "latencies": {k: v for k, v in sorted(
+                snap["latencies"].items())
+                if k.startswith("transport.")},
+            "gauges": {k: v for k, v in sorted(
+                snap.get("gauges", {}).items())
+                if k.startswith("transport.")},
+        }
+        tr = (self.cluster.transport if self.cluster is not None
+              else g_transport)
+        body["peers"] = tr.stats()
+        if self.cluster is not None:
+            hm = self.cluster.hostmap
+            body["hostmap"] = {
+                f"shard{s}": {
+                    "twin_order": hm.twin_order(s),
+                    "alive": [bool(a) for a in hm.alive[s]],
+                    "rtt_ms": [round(1000.0 * float(v), 3)
+                               for v in hm.rtt_s[s]],
+                    "addrs": self.cluster.conf.addresses[s],
+                } for s in range(hm.n_shards)}
+        return 200, json.dumps(body), "application/json"
 
     def _page_profiler(self, query: dict) -> tuple[int, str, str]:
         """Per-stage timing table + on-demand SAMPLING profiler (the

@@ -71,12 +71,20 @@ class Stats:
         self._lock = threading.Lock()
         self.counters: dict[str, int] = {}
         self.latencies: dict[str, LatencyStat] = {}
+        #: last-written point-in-time values (per-host RTT, pool sizes —
+        #: the PagePerf gauge row; counters monotonically grow, gauges
+        #: overwrite)
+        self.gauges: dict[str, float] = {}
         #: per-second samples: (epoch_s, {metric: value}) ring
         self.timeseries: deque = deque(maxlen=timeseries_window)
 
     def count(self, name: str, n: int = 1) -> None:
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + n
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self.gauges[name] = float(value)
 
     def record_ms(self, name: str, ms: float) -> None:
         with self._lock:
@@ -96,6 +104,7 @@ class Stats:
         with self._lock:
             self.counters.clear()
             self.latencies.clear()
+            self.gauges.clear()
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -103,6 +112,7 @@ class Stats:
                 "counters": dict(self.counters),
                 "latencies": {k: v.to_dict()
                               for k, v in self.latencies.items()},
+                "gauges": dict(self.gauges),
             }
 
     def series(self, last_s: float = 600.0) -> list:

@@ -1,0 +1,326 @@
+"""Cluster transport plane tests — the pooled/hedged/batched courier.
+
+Pins the four tentpole behaviors of :mod:`..parallel.transport`:
+keep-alive connection reuse with transparent reconnect (UdpServer's
+persistent endpoints), hedged twin reads that beat a wedged primary
+well under the request timeout (Multicast.cpp:520 reroute, Dean &
+Barroso hedging), batched ``/rpc/search`` scatter-gather with per-query
+result order, and the negotiated binary wire codec with a clean JSON
+fallback for mixed-version clusters. Plus: the Msg1 ordered-redelivery
+guarantee survives the pooled client.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+from open_source_search_engine_tpu.parallel import cluster as cl
+from open_source_search_engine_tpu.parallel import transport as tr
+from open_source_search_engine_tpu.utils.stats import g_stats
+
+
+def _doc(i, words="cluster shared words"):
+    return (f"<html><head><title>Doc {i}</title></head><body>"
+            f"<p>{words} token{i}.</p></body></html>")
+
+
+def _node(tmp_path, name, n_docs=3, start=True, port=0):
+    node = cl.ShardNodeServer(tmp_path / name, port=port)
+    for i in range(n_docs):
+        node.handle("/rpc/index", {"url": f"http://t.test/{name}{i}",
+                                   "content": _doc(i)})
+    if start:
+        node.start()
+    return node
+
+
+def _free_port():
+    import socket
+    sk = socket.socket()
+    sk.bind(("127.0.0.1", 0))
+    port = sk.getsockname()[1]
+    sk.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+class TestCodec:
+    PAYLOAD = {
+        "ok": True,
+        "keys": np.arange(1000, dtype=np.uint64),
+        "nested": {"scores": np.linspace(0.0, 1.0, 7),
+                   "names": ["a", "b"], "n": 3},
+        "structured": np.zeros(4, dtype=np.dtype([("k", "<u8"),
+                                                  ("v", "<u4")])),
+    }
+
+    def test_binary_roundtrip(self):
+        out = tr.decode_bin(tr.encode_bin(self.PAYLOAD))
+        assert out["ok"] is True and out["nested"]["n"] == 3
+        assert out["nested"]["names"] == ["a", "b"]
+        np.testing.assert_array_equal(out["keys"], self.PAYLOAD["keys"])
+        assert out["keys"].dtype == np.uint64
+        np.testing.assert_array_equal(out["nested"]["scores"],
+                                      self.PAYLOAD["nested"]["scores"])
+        # structured dtypes survive the JSON-header descr roundtrip
+        assert out["structured"].dtype == self.PAYLOAD["structured"].dtype
+
+    def test_json_fallback_roundtrip(self):
+        # the fallback wire is pure JSON (old peers json.loads it) and
+        # as_array recovers the arrays from the base64 .npy strings
+        wire = json.loads(json.dumps(tr.to_wire_json(self.PAYLOAD)))
+        assert isinstance(wire["keys"], str)
+        np.testing.assert_array_equal(tr.as_array(wire["keys"]),
+                                      self.PAYLOAD["keys"])
+        np.testing.assert_array_equal(
+            tr.as_array(wire["nested"]["scores"]),
+            self.PAYLOAD["nested"]["scores"])
+
+    def test_body_codec_dispatch(self):
+        for accept_bin in (True, False):
+            data, ctype = tr.encode_body(self.PAYLOAD, accept_bin)
+            out = tr.decode_body(data, ctype)
+            np.testing.assert_array_equal(tr.as_array(out["keys"]),
+                                          self.PAYLOAD["keys"])
+
+    def test_binary_wire_at_least_quarter_smaller(self):
+        # the acceptance floor: raw length-prefixed frames vs
+        # base64-.npy-inside-JSON on a bulk pull payload
+        payload = {"ok": True, "batch": {
+            "keys": np.arange(200_000, dtype=np.uint64)}}
+        bin_bytes, _ = tr.encode_body(payload, True)
+        json_bytes, _ = tr.encode_body(payload, False)
+        assert len(bin_bytes) <= 0.75 * len(json_bytes)
+
+
+# ---------------------------------------------------------------------------
+# connection pool
+# ---------------------------------------------------------------------------
+
+def test_connection_reuse_and_transparent_reconnect(tmp_path):
+    g_stats.reset()
+    node = _node(tmp_path, "a", n_docs=0)
+    t = tr.Transport()
+    addr = f"127.0.0.1:{node.port}"
+    try:
+        for _ in range(12):
+            out = t.request(addr, "/rpc/ping", {}, timeout=5.0)
+        # 12 sequential RPCs rode ONE accepted TCP connection
+        assert out["accepts"] == 1
+        snap = g_stats.snapshot()["counters"]
+        assert snap["transport.conn_dial"] == 1
+        assert snap["transport.conn_reuse"] == 11
+
+        # peer restarts: the pooled socket is now dead — the next
+        # request retries once on a fresh dial, the caller never sees it
+        port = node.port
+        node.stop()
+        node2 = _node(tmp_path, "a2", n_docs=0, port=port)
+        try:
+            out = t.request(addr, "/rpc/ping", {}, timeout=5.0)
+            assert out["ok"] and out["accepts"] == 1
+            assert g_stats.snapshot()["counters"][
+                "transport.conn_retry"] >= 1
+        finally:
+            node2.stop()
+    finally:
+        t.close()
+        node.stop()
+
+
+def test_binary_and_json_pull_all_decode_identically(tmp_path):
+    """Mixed-version matrix: a binary-advertising client gets raw
+    ndarray frames, a JSON-only (old) client gets the base64 wire —
+    and both decode to the same RecordBatch."""
+    node = _node(tmp_path, "pull", n_docs=3)
+    addr = f"127.0.0.1:{node.port}"
+    t_bin, t_json = tr.Transport(binary=True), tr.Transport(binary=False)
+    try:
+        out_b = t_bin.request(addr, "/rpc/pull-all", {}, timeout=30.0)
+        out_j = t_json.request(addr, "/rpc/pull-all", {}, timeout=30.0)
+        assert isinstance(out_b["rdbs"]["posdb"]["keys"], np.ndarray)
+        assert isinstance(out_j["rdbs"]["posdb"]["keys"], str)
+        for name in out_b["rdbs"]:
+            bb = cl._decode_batch(out_b["rdbs"][name])
+            bj = cl._decode_batch(out_j["rdbs"][name])
+            np.testing.assert_array_equal(bb.keys, bj.keys)
+            if bb.data is not None:
+                np.testing.assert_array_equal(bb.data, bj.data)
+    finally:
+        t_bin.close()
+        t_json.close()
+        node.stop()
+
+
+# ---------------------------------------------------------------------------
+# batched scatter-gather
+# ---------------------------------------------------------------------------
+
+def test_batched_rpc_search_returns_per_query_results_in_order(tmp_path):
+    node = cl.ShardNodeServer(tmp_path / "b")
+    node.handle("/rpc/index", {"url": "http://t.test/apple",
+                               "content": _doc(0, "apple orchard")})
+    node.handle("/rpc/index", {"url": "http://t.test/pie",
+                               "content": _doc(1, "pie crust")})
+    node.start()
+    t = tr.Transport()
+    try:
+        out = t.request(f"127.0.0.1:{node.port}", "/rpc/search",
+                        {"queries": ["apple", "zebra", "pie"],
+                         "topk": 5, "lang": 0}, timeout=30.0)
+        assert out["ok"]
+        totals = [r["total"] for r in out["results"]]
+        assert totals == [1, 0, 1]
+        # binary reply: docids come back as real ndarrays
+        assert isinstance(out["results"][0]["docids"], np.ndarray)
+    finally:
+        t.close()
+        node.stop()
+
+
+def test_search_batch_coalesces_and_keeps_input_order(tmp_path):
+    g_stats.reset()
+    node = cl.ShardNodeServer(tmp_path / "sb")
+    node.handle("/rpc/index", {"url": "http://t.test/apple",
+                               "content": _doc(0, "apple orchard")})
+    node.handle("/rpc/index", {"url": "http://t.test/pie",
+                               "content": _doc(1, "pie crust")})
+    node.start()
+    conf = cl.HostsConf.parse(f"num-mirrors: 0\n127.0.0.1:{node.port}")
+    client = cl.ClusterClient(conf, use_heartbeat=False)
+    try:
+        res = client.search_batch(["apple", "zebra", "pie"], topk=5,
+                                  with_snippets=False,
+                                  site_cluster=False)
+        assert [r.total_matches for r in res] == [1, 0, 1]
+        assert res[0].query == "apple" and res[2].query == "pie"
+        # the legs coalesced into batched node dispatches
+        assert g_stats.snapshot()["counters"][
+            "transport.node_batched_q"] >= 3
+    finally:
+        client.close()
+        node.stop()
+
+
+# ---------------------------------------------------------------------------
+# hedged twin reads
+# ---------------------------------------------------------------------------
+
+def test_hedged_read_beats_wedged_twin(tmp_path):
+    """The primary twin sits on a search; the hedge fires after the
+    (floored) hedge delay, the other twin answers, and the caller gets
+    a full non-degraded result in a small fraction of the request
+    timeout. The wedged twin stays ALIVE (slow is not dead) but loses
+    its primary slot in the twin ordering."""
+    docs = {f"http://t.test/h{i}": _doc(i) for i in range(3)}
+    a = cl.ShardNodeServer(tmp_path / "wedged")
+    b = cl.ShardNodeServer(tmp_path / "healthy")
+    for url, html in docs.items():
+        a.handle("/rpc/index", {"url": url, "content": html})
+        b.handle("/rpc/index", {"url": url, "content": html})
+    a.start()
+    b.start()
+    conf = cl.HostsConf.parse(
+        f"num-mirrors: 1\n127.0.0.1:{a.port}\n127.0.0.1:{b.port}")
+    client = cl.ClusterClient(conf, use_heartbeat=False)
+
+    wedge = threading.Event()
+    real_handle = a.handle
+
+    def wedged_handle(path, payload):
+        if path == "/rpc/search":
+            wedge.wait(10.0)
+        return real_handle(path, payload)
+
+    a.handle = wedged_handle
+    # seed the twin ordering so the WEDGED node is the primary pick
+    client.hostmap.rtt_s[0, 0] = 0.001
+    client.hostmap.rtt_s[0, 1] = 0.002
+    g_stats.reset()
+    try:
+        t0 = time.monotonic()
+        res = client.search("cluster shared", topk=5,
+                            with_snippets=False, site_cluster=False)
+        elapsed = time.monotonic() - t0
+        assert not res.degraded
+        assert res.total_matches == len(docs)
+        assert elapsed < 0.25 * cl.SEARCH_TIMEOUT_S
+        snap = g_stats.snapshot()["counters"]
+        assert snap["transport.hedge_fired"] >= 1
+        assert snap["transport.hedge_won"] >= 1
+        # slow-not-dead: still alive, but demoted from primary
+        assert bool(client.hostmap.alive[0, 0])
+        assert client.hostmap.twin_order(0)[0] == 1
+
+        # the whole story is visible on /admin/transport
+        from open_source_search_engine_tpu.serve.server import \
+            SearchHTTPServer
+        srv = SearchHTTPServer(str(tmp_path / "web"), port=0,
+                               cluster=client)
+        srv.start()
+        try:
+            body = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/admin/transport",
+                timeout=10.0))
+            assert body["counters"]["transport.hedge_fired"] >= 1
+            assert body["hostmap"]["shard0"]["twin_order"] == [1, 0]
+            assert any(addr.endswith(str(b.port))
+                       for addr in body["peers"])
+        finally:
+            srv.stop()
+    finally:
+        wedge.set()
+        client.close()
+        a.stop()
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
+# ordered redelivery under the pooled client
+# ---------------------------------------------------------------------------
+
+def test_hostqueue_ordered_redelivery_with_pooled_client(tmp_path):
+    """Msg1 semantics survive the transport rebuild: writes to a dead
+    twin park in order, redeliver in order when it returns, and the
+    NEWEST version of a rewritten URL wins on the caught-up twin."""
+    a = _node(tmp_path, "live", n_docs=0)
+    port_b = _free_port()
+    conf = cl.HostsConf.parse(
+        f"num-mirrors: 1\n127.0.0.1:{a.port}\n127.0.0.1:{port_b}")
+    client = cl.ClusterClient(conf, use_heartbeat=False)
+    t = tr.Transport()
+    try:
+        # twin b is down: v1 then v2 of the same URL park in its queue
+        client.index_document("http://t.test/versioned",
+                              _doc(0, "first edition"))
+        client.index_document("http://t.test/versioned",
+                              _doc(0, "second edition"))
+        assert client.pending_writes >= 1
+        b = cl.ShardNodeServer(tmp_path / "back", port=port_b)
+        b.start()
+        try:
+            deadline = time.monotonic() + 30.0
+            while client.pending_writes and time.monotonic() < deadline:
+                time.sleep(0.2)
+            assert client.pending_writes == 0
+            # ordered drain: the twin's final state is v2, not v1
+            out = t.request(f"127.0.0.1:{port_b}", "/rpc/search",
+                            {"q": "second edition", "topk": 5},
+                            timeout=30.0)
+            assert out["total"] == 1
+            out = t.request(f"127.0.0.1:{port_b}", "/rpc/search",
+                            {"q": "first edition", "topk": 5},
+                            timeout=30.0)
+            assert out["total"] == 0
+        finally:
+            b.stop()
+    finally:
+        t.close()
+        client.close()
+        a.stop()

@@ -100,11 +100,11 @@ class TestSlowReadLiveness:
             return {"ok": True, "total": 0,
                     "docids": [], "scores": []}
 
-        monkeypatch.setattr(cluster_mod, "_rpc", fake_rpc)
+        monkeypatch.setattr(cc.transport, "request", fake_rpc)
         out = cc._read_shard(0, "/rpc/search", {"q": "x"})
         assert out is not None                       # twin answered
         assert bool(cc.hostmap.alive[0, 0])          # NOT dead-marked
-        assert cc._read_ewma[0][0] >= 1.0            # but penalized
+        assert cc.hostmap.rtt_s[0, 0] >= 1.0         # but penalized
         assert ("127.0.0.1:1", "/rpc/ping") in calls
 
     def test_dead_host_still_dead_marks(self, tmp_path, monkeypatch):
@@ -119,7 +119,7 @@ class TestSlowReadLiveness:
             return {"ok": True, "total": 0,
                     "docids": [], "scores": []}
 
-        monkeypatch.setattr(cluster_mod, "_rpc", fake_rpc)
+        monkeypatch.setattr(cc.transport, "request", fake_rpc)
         out = cc._read_shard(0, "/rpc/search", {"q": "x"})
         assert out is not None
         assert not bool(cc.hostmap.alive[0, 0])      # dead-marked

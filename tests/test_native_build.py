@@ -107,6 +107,32 @@ class TestTokenizerParity:
             assert py.text == nat.text, html
             assert py.sentence_ids == nat.sentence_ids, html
 
+    def test_unquoted_attr_trailing_slash_not_selfclose(self):
+        # html.parser treats the '/' in <a href=foo/> as the TAIL OF
+        # THE UNQUOTED VALUE (href="foo/"), not a self-closing slash —
+        # a native parser that reads it as self-close drops the anchor
+        # text out of the <a> scope (no link tuple, wrong hashgroups)
+        cases = [
+            "<a href=foo/>anchor text</a> tail",     # '/' in the value
+            "<a href=foo />anchor</a>",              # real self-close
+            '<a href="foo"/>anchor</a>',             # quoted + '/'
+            "<a href=/>anchor</a>",                  # bare-slash value
+            "<a checked/>anchor</a>",                # boolean attr
+            "<a href=a/ b=c/>anchor</a>",            # '/' mid-list
+        ]
+        for frag in cases:
+            html = f"<html><body>{frag}</body></html>"
+            py, nat = _both(html, URL)
+            assert py.words == nat.words, frag
+            assert py.links == nat.links, frag
+            assert py.hashgroups == nat.hashgroups, frag
+            assert py.wordpos == nat.wordpos, frag
+        # non-vacuous: the first case really keeps the '/' in the value
+        # and the anchor text inside the link
+        py, _ = _both("<html><body><a href=foo/>anchor text</a>"
+                      "</body></html>", URL)
+        assert ("foo/", "anchor text") in py.links
+
     def test_plain_text_parity(self):
         os.environ["OSSE_NATIVE_TOKENIZE"] = "0"
         try:

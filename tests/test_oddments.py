@@ -175,3 +175,20 @@ class TestAlerting:
         assert len(lines) == 2
         assert lines[0].startswith("dead ")
         assert lines[1].startswith("recovered ")
+
+
+class TestTransportLint:
+    def test_no_raw_urlopen_in_parallel_package(self):
+        """Every cluster RPC must flow through the pooled transport —
+        a stray ``urllib.request.urlopen`` in ``parallel/`` would dial
+        a fresh TCP connection per call, bypassing the keep-alive pool,
+        the RTT EWMAs, and the ``transport.*`` stats."""
+        from pathlib import Path
+
+        import open_source_search_engine_tpu.parallel as par
+        for py in Path(par.__file__).parent.glob("*.py"):
+            if py.name == "transport.py":
+                continue  # the one sanctioned courier (http.client)
+            text = py.read_text(encoding="utf-8")
+            assert "urlopen" not in text, (
+                f"{py.name} bypasses the pooled transport")

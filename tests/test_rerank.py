@@ -116,18 +116,10 @@ def test_read_ewma_prefers_faster_twin():
                      addresses=[["127.0.0.1:1", "127.0.0.1:2"]])
     cc = ClusterClient(conf, use_heartbeat=False)
     try:
-        cc._read_ewma[0][0] = 0.5   # slow twin
-        cc._read_ewma[0][1] = 0.01  # fast twin
-        order = sorted(
-            range(2),
-            key=lambda r: (not cc.hostmap.alive[0, r],
-                           cc._read_ewma[0][r]))
-        assert order == [1, 0]
+        cc.hostmap.rtt_s[0, 0] = 0.5   # slow twin
+        cc.hostmap.rtt_s[0, 1] = 0.01  # fast twin
+        assert cc.hostmap.twin_order(0) == [1, 0]
         cc.hostmap.mark_dead(0, 1)  # liveness dominates latency
-        order = sorted(
-            range(2),
-            key=lambda r: (not cc.hostmap.alive[0, r],
-                           cc._read_ewma[0][r]))
-        assert order == [0, 1]
+        assert cc.hostmap.twin_order(0) == [0, 1]
     finally:
         cc.close()
