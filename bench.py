@@ -307,6 +307,73 @@ def main_transport() -> None:
     }))
 
 
+def main_trace() -> None:
+    """Tracing-plane microbench (BENCH_TRACE=1): the cost of leaving
+    the tracer ON in production. A/B on the host (CPU) query path:
+    tracing disabled (sample_n=0) vs enabled-but-unsampled (the 1-in-N
+    steady state every non-kept query pays) — alternating best-of-N
+    passes so clock drift hits both arms equally. The unsampled arm
+    must stay within 2% of disabled, or this exits 1. Also reports the
+    open/close cost of one SAMPLED span (the price a kept trace pays
+    per stage)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from open_source_search_engine_tpu.build import docproc
+    from open_source_search_engine_tpu.index.collection import Collection
+    from open_source_search_engine_tpu.query import engine
+    from open_source_search_engine_tpu.utils import trace as tm
+    from open_source_search_engine_tpu.utils.trace import g_tracer
+
+    bdir = tempfile.mkdtemp(prefix="osse_bench_trace_")
+    coll = Collection("trbench", bdir)
+    docproc.index_batch(coll, [
+        (f"http://bench.test/t{d}",
+         f"<html><body><p>trace bench words filler token{d % 37} "
+         f"extra{d % 11}</p></body></html>")
+        for d in range(240)])
+    qs = [f"bench token{k % 37}" for k in range(48)]
+
+    def one_pass(sample_n: int) -> float:
+        g_tracer.configure(sample_n=sample_n, slow_ms=1e12)
+        t0 = time.perf_counter()
+        for q in qs:
+            with g_tracer.start("bench.query", q=q):
+                engine.search(coll, q, topk=10, with_snippets=False)
+        return time.perf_counter() - t0
+
+    one_pass(0)          # warm: compiles/caches out of the measurement
+    one_pass(10 ** 9)
+    passes = int(os.environ.get("BENCH_TRACE_PASSES", "7"))
+    best_off = best_on = float("inf")
+    for _ in range(passes):
+        best_off = min(best_off, one_pass(0))
+        best_on = min(best_on, one_pass(10 ** 9))
+    overhead = (best_on - best_off) / best_off
+
+    # sampled span cost: tight open/close loop under one kept trace
+    n_spans = 50_000
+    g_tracer.configure(sample_n=1)
+    with g_tracer.start("bench.spans", sampled=True):
+        t0 = time.perf_counter()
+        for _ in range(n_spans):
+            with tm.span("s"):
+                pass
+        span_s = time.perf_counter() - t0
+    g_tracer.ring.clear()
+
+    ok = overhead < 0.02
+    print(json.dumps({
+        "metric": "trace_unsampled_overhead_pct",
+        "value": round(100.0 * overhead, 3), "unit": "%",
+        "ok": ok, "budget_pct": 2.0,
+        "best_off_s": round(best_off, 4),
+        "best_unsampled_s": round(best_on, 4),
+        "queries_per_pass": len(qs),
+        "ns_per_span_sampled": round(1e9 * span_s / n_spans, 1),
+    }))
+    if not ok:
+        sys.exit(1)
+
+
 def main() -> None:
     try:
         jax = _init_backend()
@@ -586,5 +653,7 @@ if __name__ == "__main__":
         main_mesh(int(os.environ["BENCH_MESH"]))
     elif os.environ.get("BENCH_TRANSPORT"):
         main_transport()
+    elif os.environ.get("BENCH_TRACE"):
+        main_trace()
     else:
         main()

@@ -49,6 +49,7 @@ import numpy as np
 
 from ..index.collection import Collection
 from ..utils import ghash
+from ..utils import trace as trace_mod
 from ..utils.log import get_logger
 from ..utils.stats import g_stats
 from . import transport as transport_mod
@@ -473,11 +474,27 @@ class ShardNodeServer:
                     nice = 0
                 accept_bin = BIN_CONTENT_TYPE in (
                     self.headers.get("Accept") or "")
+                # adopt an incoming trace context: run the handler
+                # under a local root span and ship the finished
+                # subtree back in the reply for the coordinator to
+                # graft into its tree (Dapper-style child spans)
+                tr_hdr = trace_mod.parse_header(
+                    self.headers.get(trace_mod.TRACE_HEADER) or "")
                 outer.nice_gate.enter(nice)
                 try:
                     payload = transport_mod.decode_body(
                         body, self.headers.get("Content-Type", ""))
-                    out = outer.handle(self.path, payload)
+                    if tr_hdr is not None:
+                        with trace_mod.g_tracer.adopt(
+                                tr_hdr[0], tr_hdr[1],
+                                self.path.lstrip("/"),
+                                host=f"{outer.host}:{outer.port}"
+                                ) as adopted:
+                            out = outer.handle(self.path, payload)
+                        if isinstance(out, dict):
+                            out["_trace"] = adopted.export()
+                    else:
+                        out = outer.handle(self.path, payload)
                     code = 200
                 except KeyError:
                     out, code = {"error": "no such rpc"}, 404
@@ -615,10 +632,11 @@ class _ShardSearchBatcher:
         self._thread: threading.Thread | None = None
 
     def submit(self, q: str, topk: int, lang: int,
-               timeout: float) -> dict | None:
+               timeout: float,
+               parent_span=None) -> dict | None:
         holder = {"done": False, "out": None}
         with self._cv:
-            self._queue.append(((topk, lang), q, holder))
+            self._queue.append(((topk, lang), q, holder, parent_span))
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threading.Thread(
                     target=self._run, daemon=True,
@@ -654,28 +672,39 @@ class _ShardSearchBatcher:
             except Exception as e:  # noqa: BLE001 — keep the lane alive
                 log.warning("shard %d batch failed: %s", self.shard, e)
                 with self._cv:
-                    for _, _, holder in batch:
-                        holder["done"] = True
+                    for entry in batch:
+                        entry[2]["done"] = True
                     self._cv.notify_all()
 
     def _issue(self, key: tuple, batch: list) -> None:
         topk, lang = key
-        qs = [q for _, q, _ in batch]
-        out = self.client._read_shard(
-            self.shard, "/rpc/search",
-            {"queries": qs, "topk": topk, "lang": lang},
-            timeout=SEARCH_TIMEOUT_S)
-        results = out.get("results") if out else None
-        if not isinstance(results, list) or len(results) != len(qs):
-            # old node (no batch support → 404 on "queries") or a
-            # malformed reply: legacy single-query wire, one per entry
-            g_stats.count("transport.batch_fallback")
-            results = [self.client._read_shard(
+        qs = [q for _, q, _, _ in batch]
+        # the batcher runs in its own thread (empty contextvars
+        # context); re-attach the first waiter's span so the coalesced
+        # RPC lands in SOME trace, and give every other waiter a
+        # completed "coalesced" marker span covering the same interval
+        parents = [p for _, _, _, p in batch if p is not None]
+        primary = parents[0] if parents else None
+        t0 = time.perf_counter()
+        with trace_mod.attach(primary):
+            out = self.client._read_shard(
                 self.shard, "/rpc/search",
-                {"q": q, "topk": topk, "lang": lang},
-                timeout=SEARCH_TIMEOUT_S) for q in qs]
+                {"queries": qs, "topk": topk, "lang": lang},
+                timeout=SEARCH_TIMEOUT_S)
+            results = out.get("results") if out else None
+            if not isinstance(results, list) or len(results) != len(qs):
+                # old node (no batch support → 404 on "queries") or a
+                # malformed reply: legacy single-query wire, one per entry
+                g_stats.count("transport.batch_fallback")
+                results = [self.client._read_shard(
+                    self.shard, "/rpc/search",
+                    {"q": q, "topk": topk, "lang": lang},
+                    timeout=SEARCH_TIMEOUT_S) for q in qs]
+        for p in parents[1:]:
+            p.record("rpc/search", t0, coalesced=True,
+                     shard=self.shard, batch=len(qs))
         with self._cv:
-            for (_, _, holder), res in zip(batch, results):
+            for (_, _, holder, _), res in zip(batch, results):
                 holder["out"] = res
                 holder["done"] = True
             self._cv.notify_all()
@@ -915,7 +944,8 @@ class ClusterClient:
     # --- reads (Multicast serving-twin pick + reroute) -------------------
 
     def _read_shard(self, shard: int, path: str, payload: dict,
-                    timeout: float = RPC_TIMEOUT_S) -> dict | None:
+                    timeout: float = RPC_TIMEOUT_S,
+                    span_parent=None) -> dict | None:
         """Hedged twin read: the primary goes to the currently-fastest
         live twin (Multicast.cpp:520 pickBestHost — alive first, then
         lowest RTT EWMA); if it fails outright the next twin launches
@@ -934,7 +964,8 @@ class ClusterClient:
         addrs = [self.conf.addresses[shard][r] for r in order]
         t0 = time.monotonic()
         out, winner, failures = self.transport.hedged(
-            addrs, path, payload, timeout=timeout)
+            addrs, path, payload, timeout=timeout,
+            span_parent=span_parent)
         for i, err in failures:
             r = order[i]
             if isinstance(err, transport_mod.NotOkError):
@@ -968,11 +999,14 @@ class ClusterClient:
     # --- scatter-gather query (Msg3a) ------------------------------------
 
     def _search_shard(self, shard: int, q: str, topk: int,
-                      lang: int) -> dict | None:
+                      lang: int, parent_span=None) -> dict | None:
         """One shard's leg of the scatter: rides the per-shard batcher
-        so concurrent queries coalesce into one (hedged) RPC."""
+        so concurrent queries coalesce into one (hedged) RPC.
+        ``parent_span`` carries the caller's trace across the
+        read-pool thread hop (contextvars don't follow threads)."""
         return self._batchers[shard].submit(q, topk, lang,
-                                            SEARCH_TIMEOUT_S)
+                                            SEARCH_TIMEOUT_S,
+                                            parent_span=parent_span)
 
     def search_batch(self, queries: list[str], topk: int = 10,
                      lang: int = 0, with_snippets: bool = True,
@@ -1006,8 +1040,13 @@ class ClusterClient:
 
         want = max(topk + offset, PQR_SCAN)
         over = max(want * 2, 16)
+        # the scatter span is handed to each leg explicitly: the legs
+        # run on read-pool threads, where the contextvar trace context
+        # does not follow
+        scatter_sp = trace_mod.begin("scatter",
+                                     shards=self.conf.n_shards)
         futs = [self._read_pool.submit(
-            self._search_shard, s, q, over, lang)
+            self._search_shard, s, q, over, lang, scatter_sp)
             for s in range(self.conf.n_shards)]
         total = 0
         docids: list[int] = []
@@ -1028,16 +1067,21 @@ class ClusterClient:
             docids += [int(x) for x in as_array(out.get("docids", []))]
             scores += [float(x)
                        for x in as_array(out.get("scores", []))]
-        order = np.argsort(-np.asarray(scores, dtype=np.float64),
-                           kind="stable")
-        plan = compile_query(q, lang=lang)
+        if scatter_sp is not None:
+            scatter_sp.tag(degraded=degraded)
+            scatter_sp.finish()
+        with trace_mod.timed_span("query.merge", docs=len(docids)):
+            order = np.argsort(-np.asarray(scores, dtype=np.float64),
+                               kind="stable")
+            plan = compile_query(q, lang=lang)
         # prefetch the likely titlerecs concurrently (the reference
         # launches its Msg20 summary requests in parallel,
         # Msg40::launchMsg20s); build_results then reads the cache
         prefetch = [docids[i] for i in order[: want + 8]]
-        fetched = dict(zip(prefetch,
-                           self._read_pool.map(self.get_document,
-                                               prefetch)))
+        with trace_mod.span("query.prefetch", docs=len(prefetch)):
+            fetched = dict(zip(prefetch,
+                               self._read_pool.map(self.get_document,
+                                                   prefetch)))
         get_doc = lambda d: fetched.get(d) if d in fetched \
             else self.get_document(d)
         results, clustered = build_results(

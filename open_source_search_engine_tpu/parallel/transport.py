@@ -41,6 +41,7 @@ import time
 
 import numpy as np
 
+from ..utils import trace as trace_mod
 from ..utils.log import get_logger
 from ..utils.stats import g_stats
 
@@ -344,7 +345,8 @@ class Transport:
     # --- single request ---------------------------------------------------
 
     def request(self, addr: str, path: str, payload: dict,
-                timeout: float, niceness: int = 0) -> dict:
+                timeout: float, niceness: int = 0,
+                span: "trace_mod.Span | None" = None) -> dict:
         """One RPC over a pooled connection.
 
         A send/recv failure on a REUSED socket retries once on a fresh
@@ -357,12 +359,40 @@ class Transport:
         Request bodies are ALWAYS JSON — an old node would reject a
         binary body outright. Only the REPLY codec is negotiated: the
         ``Accept`` header advertises binary, and a node that doesn't
-        understand it simply answers JSON."""
+        understand it simply answers JSON.
+
+        Tracing: inside a sampled trace the RPC gets a child span
+        (``rpc/...``) and the ``X-OSSE-Trace`` header; the node ships
+        its subtree back under ``"_trace"``, grafted here. ``span``
+        lets :meth:`hedged` pass pre-made per-attempt spans across its
+        launch threads (contextvars don't follow threads)."""
+        sp = span if span is not None else \
+            trace_mod.begin(path.lstrip("/"), addr=addr)
+        try:
+            out = self._request_inner(addr, path, payload, timeout,
+                                      niceness, sp)
+        except Exception as e:  # noqa: BLE001
+            if sp is not None:
+                sp.tag(error=repr(e))
+            raise
+        finally:
+            if sp is not None:
+                sp.finish()
+        if sp is not None and isinstance(out, dict):
+            sub = out.pop("_trace", None)
+            if sub is not None:
+                sp.graft(sub)
+        return out
+
+    def _request_inner(self, addr, path, payload, timeout, niceness,
+                       sp) -> dict:
         body = json.dumps(to_wire_json(payload)).encode()
         headers = {"Content-Type": "application/json",
                    "X-Niceness": str(niceness)}
         if self.binary:
             headers["Accept"] = BIN_CONTENT_TYPE
+        if sp is not None:
+            headers[trace_mod.TRACE_HEADER] = trace_mod.header_for(sp)
         t0 = time.monotonic()
         for attempt in (0, 1):
             conn, reused = self._checkout(addr, timeout)
@@ -406,7 +436,8 @@ class Transport:
 
     def hedged(self, addrs: list[str], path: str, payload: dict,
                timeout: float, niceness: int = 0,
-               is_ok=None) -> tuple[dict | None, int, list]:
+               is_ok=None, span_parent=None
+               ) -> tuple[dict | None, int, list]:
         """The same request raced across twins, tail-latency style.
 
         ``addrs[0]`` (caller pre-sorts fastest-live-first) launches
@@ -423,6 +454,8 @@ class Transport:
         dead; liveness stays with the heartbeat prober)."""
         if is_ok is None:
             is_ok = lambda o: bool(o.get("ok")) or "total" in o
+        parent = span_parent if span_parent is not None else \
+            trace_mod.current_span()
         deadline = time.monotonic() + timeout
         cv = threading.Condition()
         #: per attempt: None = in flight, ("ok", out) or ("err", e)
@@ -430,11 +463,16 @@ class Transport:
         launched = [False] * len(addrs)
         launch_t = [0.0] * len(addrs)
         hedge_launch = [False] * len(addrs)
+        spans: list = [None] * len(addrs)
 
         def run(i: int) -> None:
             try:
+                # span= only when tracing: tests monkeypatch request()
+                # with the plain 5-arg signature
+                kw = {} if spans[i] is None else {"span": spans[i]}
                 out = self.request(addrs[i], path, payload,
-                                   timeout=timeout, niceness=niceness)
+                                   timeout=timeout, niceness=niceness,
+                                   **kw)
                 res = ("ok", out) if is_ok(out) else \
                     ("err", NotOkError(f"{addrs[i]}{path}: not ok"))
             except Exception as e:  # noqa: BLE001
@@ -449,6 +487,9 @@ class Transport:
             hedge_launch[i] = hedge
             if hedge:
                 g_stats.count("transport.hedge_fired")
+            if parent is not None:
+                spans[i] = parent.child(path.lstrip("/"),
+                                        addr=addrs[i], hedge=hedge)
             threading.Thread(target=run, args=(i,), daemon=True,
                              name=f"hedge-{path.rsplit('/', 1)[-1]}-{i}"
                              ).start()
@@ -499,6 +540,9 @@ class Transport:
                 cv.wait(min(fire_at - now, max(deadline - now, 0.0)))
         if winner >= 0 and hedge_launch[winner]:
             g_stats.count("transport.hedge_won")
+        if winner >= 0 and spans[winner] is not None:
+            spans[winner].tag(won=True,
+                              hedge_won=bool(hedge_launch[winner]))
         failures = [(i, state[i][1]) for i in range(len(addrs))
                     if state[i] is not None and state[i][0] == "err"]
         return result, winner, failures

@@ -98,6 +98,7 @@ from ..index import clusterdb as clusterdb_mod
 from ..index import posdb
 from ..index.collection import Collection
 from ..index.rdblite import merge_batches
+from ..utils import trace
 from ..utils.log import get_logger
 from . import devcheck, weights
 from .compiler import SUB_SYNONYM, QueryPlan, compile_query
@@ -1323,6 +1324,7 @@ class DeviceIndex:
                  for qp in qplans]
         g_stats.record_ms("devindex.plan",
                           1000 * (time.perf_counter() - t_plan))
+        trace.record("devindex.plan", t_plan, queries=len(qplans))
         live = [i for i, p in enumerate(plans) if p.matchable]
         results = [(np.empty(0, np.uint64), np.empty(0, np.float32), 0)
                    ] * len(plans)
@@ -1468,12 +1470,23 @@ class DeviceIndex:
                                       k2v, f2_nsel)))
             g_stats.record_ms("devindex.issue",
                               1000 * (time.perf_counter() - t_issue))
+            trace.record("devindex.issue", t_issue, waves=len(waves))
             t_fetch = time.perf_counter()
             outs = jax.device_get([w[4] for w in waves])
             g_stats.record_ms(
                 "devindex.wave_" + "+".join(sorted({w[0] for w in waves}))
                 + f"_n{len(waves)}",
                 1000 * (time.perf_counter() - t_fetch))
+            # device-time attribution: device_get blocks until every
+            # issued wave completes (the block_until_ready delta), so
+            # this interval IS the device time of the round, and the
+            # fetched buffers are the bytes moved device→host
+            trace.record(
+                "devindex.device",
+                t_fetch,
+                kinds="+".join(sorted({w[0] for w in waves})),
+                waves=len(waves),
+                bytes=int(sum(np.asarray(o).nbytes for o in outs)))
             f1_next: list[int] = []
             f2_next: list[int] = []
             for (kind, kappa, k2g, idxs, _), out in zip(waves, outs):

@@ -20,6 +20,7 @@ import numpy as np
 
 from ..build import docproc
 from ..index.collection import Collection
+from ..utils import trace
 from ..utils.log import get_logger
 from ..utils.stats import g_stats
 from .compiler import QueryPlan, compile_query
@@ -177,16 +178,19 @@ def finish_page(results, *, offset: int, topk: int, conf=None,
     the page rows only (deep pages must not pay snippets for the rows
     they skip)."""
     from . import summary as summary_mod
-    apply_pqr(results, conf, qlang, langid_of=langid_of)
+    with trace.timed_span("query.rerank", window=min(len(results),
+                                                     PQR_SCAN)):
+        apply_pqr(results, conf, qlang, langid_of=langid_of)
     page = results[offset:offset + topk]
     if with_snippets and get_doc is not None:
-        for r in page:
-            if not r.snippet:
-                rec = get_doc(int(r.docid))
-                if rec:
-                    r.snippet = summary_mod.make_summary(
-                        rec.get("text", ""), words or [],
-                        description=rec.get("meta_description", ""))
+        with trace.timed_span("query.summary", rows=len(page)):
+            for r in page:
+                if not r.snippet:
+                    rec = get_doc(int(r.docid))
+                    if rec:
+                        r.snippet = summary_mod.make_summary(
+                            rec.get("text", ""), words or [],
+                            description=rec.get("meta_description", ""))
     return page
 
 
@@ -200,7 +204,7 @@ def search(coll: Collection, q: str | QueryPlan, *, topk: int = 10,
     raw = plan.raw
 
     g_stats.count("query")
-    with g_stats.timed("query.prepare"):
+    with trace.timed_span("query.prepare", q=raw):
         prep = prepare_query(coll, plan)
 
     # over-fetch + escalate: when site clustering leaves the page short,
@@ -219,15 +223,19 @@ def search(coll: Collection, q: str | QueryPlan, *, topk: int = 10,
         # pressure pack_pass shrinks a pass (budget_shrink) and a fixed
         # stride would silently skip the unshrunk remainder
         doc_off = 0
+        npass = 0
         while doc_off < len(prep.cand):
-            with g_stats.timed("query.pack"):
+            with trace.timed_span("query.pack", npass=npass,
+                                  doc_off=doc_off):
                 pq = pack_pass(prep, doc_offset=doc_off,
                                max_docs=max_docs_per_pass,
                                budget_shrink=True)
             if pq is None:
                 break
-            with g_stats.timed("query.score"):
+            with trace.timed_span("query.score", npass=npass,
+                                  n_docs=pq.n_docs):
                 docids, scores, n_matched = run_query(pq, topk=k)
+            npass += 1
             total += n_matched
             all_docids.append(docids)
             all_scores.append(scores)
@@ -240,7 +248,7 @@ def search(coll: Collection, q: str | QueryPlan, *, topk: int = 10,
         scores = np.concatenate(all_scores)
         order = np.argsort(-scores, kind="stable")
 
-        with g_stats.timed("query.results"):
+        with trace.timed_span("query.results"):
             results, clustered = build_results(
                 lambda d: docproc.get_document(coll, docid=d),
                 docids[order], scores[order], plan, topk=want,
@@ -369,7 +377,8 @@ def search_device_batch(coll: Collection, queries, *, topk: int = 10,
     plans = [q if isinstance(q, QueryPlan) else compile_query(q, lang)
              for q in queries]
     g_stats.count("query", len(plans))
-    with g_stats.timed("query.device_batch"):
+    with trace.timed_span("query.device_batch", queries=len(plans),
+                          topk=max((topk + offset) * 2, 64)):
         raw = di.search_batch(plans, topk=max((topk + offset) * 2, 64),
                               lang=lang)
     out = []

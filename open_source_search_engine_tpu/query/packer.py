@@ -31,6 +31,7 @@ import numpy as np
 
 from ..index import posdb
 from ..index.collection import Collection
+from ..utils import trace
 from ..utils.membudget import g_membudget
 from . import weights
 from .compiler import SUB_SYNONYM, QueryPlan
@@ -326,7 +327,10 @@ def prepare_query(coll: Collection, plan: QueryPlan,
     lists are still returned: cluster-wide term-frequency stats must
     count a shard's postings even when that shard has no candidates.
     """
-    lists = fetch_group_lists(coll, plan)
+    with trace.span("query.fetch_lists", groups=len(plan.groups)) as sp:
+        lists = fetch_group_lists(coll, plan)
+        if sp is not None:
+            sp.tag(postings=int(sum(len(gl.docids) for gl in lists)))
     req = [i for i, g in enumerate(plan.groups)
            if g.required and not g.negative]
 
@@ -460,9 +464,13 @@ def pack_pass(prep: PreparedQuery, doc_offset: int = 0,
     est = T * L * 13 + D_pad * 13
     granted = g_membudget.reserve("pack", est)
     if not granted and budget_shrink and D > 1:
+        trace.tag(budget_shrunk=True)
         return pack_pass(prep, doc_offset, max(D // 2, 1),
                          max_positions, budget_shrink)
     try:
+        # pack dims on the enclosing query.pack span — the [T,L]/[D]
+        # shape is what decides both HBM bytes and kernel time
+        trace.tag(T=int(T), L=int(L), D=int(D), bytes=int(est))
         return _pack_arrays(prep, cand, doc_offset, per_group,
                             required, negative, scored, counts,
                             T, D, D_pad, L)

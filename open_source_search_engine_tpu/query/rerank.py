@@ -28,6 +28,7 @@ the original order for untouched results.
 
 from __future__ import annotations
 
+from ..utils import trace
 from ..utils.url import normalize
 
 
@@ -67,4 +68,6 @@ def post_query_rerank(results, qlang: int = 0, *,
                 f *= lang_demote
         r.score *= f
     results.sort(key=lambda r: -r.score)  # timsort: stable for ties
-    return sum(1 for r, d in zip(results, orig_order) if r.docid != d)
+    moved = sum(1 for r, d in zip(results, orig_order) if r.docid != d)
+    trace.tag(moved=moved)
+    return moved

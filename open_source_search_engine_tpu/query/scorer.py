@@ -44,11 +44,14 @@ scoring chain — measured ~10× slower end-to-end on v5e.
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..index.posdb import HASHGROUP_END, HASHGROUP_INLINKTEXT
+from ..utils import trace
 from . import weights
 from .packer import MAX_POSITIONS, TABLE_SIZE, PackedQuery
 
@@ -348,14 +351,20 @@ def run_query(pq: PackedQuery, topk: int = 64):
     filt = pq.filt if pq.filt is not None else np.zeros(dpad, bool)
     sortc = pq.sortc if pq.sortc is not None \
         else np.zeros(dpad, np.float32)
-    dev = jax.device_put([
-        pq.doc_idx, pq.payload, pq.slot, pq.valid, pq.freq_weight,
-        pq.required, pq.negative, pq.scored, pq.counts, pq.table,
-        pq.siterank, pq.doclang,
-        np.int32(pq.qlang), np.int32(pq.n_docs), filt, sortc])
+    up = [pq.doc_idx, pq.payload, pq.slot, pq.valid, pq.freq_weight,
+          pq.required, pq.negative, pq.scored, pq.counts, pq.table,
+          pq.siterank, pq.doclang,
+          np.int32(pq.qlang), np.int32(pq.n_docs), filt, sortc]
+    t_dev = time.perf_counter()
+    dev = jax.device_put(up)
     out = np.asarray(_score_packed(
         *dev, n_positions=MAX_POSITIONS, topk=topk,
         use_filter=pq.use_filter, use_sort=pq.use_sort))
+    # np.asarray blocks on the result — this delta is transfer + kernel
+    # (device time); bytes_up/bytes_down are the wire both ways
+    trace.record("scorer.device", t_dev,
+                 bytes_up=int(sum(np.asarray(a).nbytes for a in up)),
+                 bytes_down=int(out.nbytes))
     n_matched = int(out[0])
     top_idx = out[1:1 + k].astype(np.int64)
     top_scores = out[1 + k:].view(np.float32)

@@ -18,6 +18,8 @@ import re
 
 import numpy as np
 
+from ..utils import trace
+
 _WORD_RE = re.compile(r"\w+", re.UNICODE)
 
 #: window width in words (reference summary excerpt length ~ a sentence)
@@ -99,6 +101,17 @@ def field_matches(rec: dict, query_words: list[str]) -> dict[str, int]:
 def make_summary(text: str, query_words: list[str], *,
                  max_fragments: int = 2, window: int = WINDOW_WORDS,
                  max_chars: int = 320, description: str = "") -> str:
+    """Trace-wrapped :func:`_make_summary` — one ``summary.make`` span
+    per excerpt built (no-op outside a sampled trace)."""
+    with trace.span("summary.make", chars=len(text or "")):
+        return _make_summary(text, query_words,
+                             max_fragments=max_fragments, window=window,
+                             max_chars=max_chars, description=description)
+
+
+def _make_summary(text: str, query_words: list[str], *,
+                  max_fragments: int = 2, window: int = WINDOW_WORDS,
+                  max_chars: int = 320, description: str = "") -> str:
     """Pick the best-scoring excerpt windows for these query words.
 
     Fallback order when the body has no match (Summary.cpp's source

@@ -35,7 +35,9 @@ from ..query.summary import highlight
 from ..utils.log import get_logger
 from ..utils.membudget import g_membudget
 from ..utils import parms as parms_mod
+from ..utils import trace as trace_mod
 from ..utils.parms import Conf
+from ..utils.trace import g_tracer
 
 log = get_logger("http")
 
@@ -57,7 +59,8 @@ class QueryBatcher:
         #: run_batch((coll_name, topk, offset), [queries]) → [results]
         self._run_batch = run_batch
         self._cv = threading.Condition()
-        self._queue: list[tuple[tuple, str, dict]] = []
+        #: (key, query, holder, parent span | None)
+        self._queue: list[tuple] = []
         self._alive = True
         # two executors so batch N's host post-processing (titledb
         # reads, clustering) overlaps batch N+1's device waves
@@ -86,7 +89,8 @@ class QueryBatcher:
     def search(self, key: tuple, q: str, timeout: float = 60.0):
         holder: dict = {}
         with self._cv:
-            self._queue.append((key, q, holder))
+            self._queue.append((key, q, holder,
+                                trace_mod.current_span()))
             self._cv.notify_all()
             deadline = time.monotonic() + timeout
             while "res" not in holder and "err" not in holder:
@@ -124,7 +128,18 @@ class QueryBatcher:
 
     def _run_one(self, key, batch) -> None:
         try:
-            res = self._run_batch(key, [e[1] for e in batch])
+            # worker thread = empty contextvars context; re-attach the
+            # first traced waiter's span so the coalesced dispatch
+            # lands in SOME trace, and mark the other waiters' traces
+            # with a completed "coalesced" marker covering the interval
+            parents = [e[3] for e in batch if len(e) > 3 and
+                       e[3] is not None]
+            t0 = time.perf_counter()
+            with trace_mod.attach(parents[0] if parents else None):
+                res = self._run_batch(key, [e[1] for e in batch])
+            for p in parents[1:]:
+                p.record("query.device_batch", t0, coalesced=True,
+                         batch=len(batch))
             with self._cv:
                 for e, r in zip(batch, res):
                     e[2]["res"] = r
@@ -140,10 +155,14 @@ def _xml_escape(s: str) -> str:
     return html_mod.escape(s, quote=True)
 
 
-def render_results(res: engine.SearchResults, fmt: str) -> tuple[str, str]:
-    """SERP rendering (PageResults.cpp HTML/XML/JSON/CSV)."""
+def render_results(res: engine.SearchResults, fmt: str,
+                   trace_id: str | None = None) -> tuple[str, str]:
+    """SERP rendering (PageResults.cpp HTML/XML/JSON/CSV).
+
+    ``trace_id`` (``debug=1`` requests) is echoed in the body so a
+    user-visible query can be looked up on ``/admin/traces``."""
     if fmt == "json":
-        return json.dumps({
+        payload = {
             "query": res.query,
             "totalMatches": res.total_matches,
             "clustered": res.clustered,
@@ -155,7 +174,10 @@ def render_results(res: engine.SearchResults, fmt: str) -> tuple[str, str]:
                  "title": r.title, "snippet": r.snippet, "site": r.site}
                 for r in res.results
             ],
-        }), "application/json"
+        }
+        if trace_id:
+            payload["traceId"] = trace_id
+        return json.dumps(payload), "application/json"
     if fmt == "xml":
         rows = "".join(
             f"<result><docId>{r.docid}</docId>"
@@ -164,10 +186,12 @@ def render_results(res: engine.SearchResults, fmt: str) -> tuple[str, str]:
             f"<title>{_xml_escape(r.title)}</title>"
             f"<snippet>{_xml_escape(r.snippet)}</snippet></result>"
             for r in res.results)
+        tid = (f"<traceId>{_xml_escape(trace_id)}</traceId>"
+               if trace_id else "")
         return (f'<?xml version="1.0" encoding="UTF-8"?>'
                 f"<response><query>{_xml_escape(res.query)}</query>"
                 f"<totalMatches>{res.total_matches}</totalMatches>"
-                f"{rows}</response>", "text/xml")
+                f"{tid}{rows}</response>", "text/xml")
     if fmt == "csv":
         lines = ["docid,score,url,title"]
         for r in res.results:
@@ -182,12 +206,15 @@ def render_results(res: engine.SearchResults, fmt: str) -> tuple[str, str]:
         f"<br><code>{html_mod.escape(r.url)}</code> "
         f"<i>{r.score:.1f}</i></li>"
         for r in res.results)
+    tid = (f'<p><small>trace <a href="/admin/traces?id='
+           f'{html_mod.escape(trace_id)}">{html_mod.escape(trace_id)}'
+           f"</a></small></p>" if trace_id else "")
     return (f"<html><head><title>{html_mod.escape(res.query)} - search"
             f"</title></head><body>"
             f'<form action="/search"><input name="q" '
             f'value="{html_mod.escape(res.query)}"><input type="submit" '
             f'value="search"></form>'
-            f"<p>{res.total_matches} matches</p><ol>{items}</ol>"
+            f"<p>{res.total_matches} matches</p><ol>{items}</ol>{tid}"
             f"</body></html>", "text/html")
 
 
@@ -214,6 +241,13 @@ class SearchHTTPServer:
         g_membudget.set_limit(self.conf.max_mem)
         if self.conf.checkify:
             devcheck.set_enabled(True)
+        # trace plane wiring: sampling + slow-query threshold from the
+        # parms, slowlog next to statsdb (process-global tracer — the
+        # last server constructed in a process owns the slowlog path)
+        g_tracer.configure(sample_n=self.conf.trace_sample,
+                           slow_ms=self.conf.slow_query_ms,
+                           slowlog_path=Path(base_dir) / "slowlog.jsonl",
+                           host=f"{host}:{port}")
         self.conf.on_update(self._on_guardrail_parm)
         self.stats = {"queries": 0, "injects": 0, "addurls": 0,
                       "gets": 0, "errors": 0, "auth_denied": 0}
@@ -260,6 +294,10 @@ class SearchHTTPServer:
             # False reverts to the env default rather than forcing off,
             # so OSSE_CHECKIFY=1 test runs survive a parm sync
             devcheck.set_enabled(True if value else None)
+        elif name == "trace_sample":
+            g_tracer.configure(sample_n=int(value))
+        elif name == "slow_query_ms":
+            g_tracer.configure(slow_ms=float(value))
 
     BAN_COOLDOWN_S = 60.0
 
@@ -429,6 +467,8 @@ class SearchHTTPServer:
             return self._page_mem(query)
         if path == "/admin/transport":
             return self._page_transport(query)
+        if path == "/admin/traces":
+            return self._page_traces(query)
         if path == "/admin/parms":
             return self._page_parms(query)
         return 404, json.dumps({"error": "no such page"}), \
@@ -459,6 +499,16 @@ class SearchHTTPServer:
         if not q:
             return 400, json.dumps({"error": "missing q"}), \
                 "application/json"
+        # debug=1: force-sample this query's trace and echo the trace
+        # id in the body so the waterfall can be pulled up by id
+        debug = query.get("debug", "") not in ("", "0")
+        with g_tracer.start("search", sampled=True if debug else None,
+                            q=q) as tr:
+            out = self._page_search_traced(query, q, debug, tr)
+        return out
+
+    def _page_search_traced(self, query: dict, q: str, debug: bool,
+                            tr) -> tuple[int, str, str]:
         n = min(int(query.get("n", 10)), 100)
         # deep paging: first result number (reference PageResults s=),
         # bounded so a hostile s can't force a corpus-sized top-k
@@ -476,13 +526,17 @@ class SearchHTTPServer:
         ttl = float(getattr(rc_coll.conf, "result_cache_ttl", 0)
                     if rc_coll is not None else 0)
         ckey = None
-        if ttl > 0:
+        # debug requests bypass the result cache both ways: a cached
+        # body would echo a STALE trace id, and a debug body must not
+        # poison the cache for ordinary requests
+        if ttl > 0 and not debug:
             ver = rc_coll.posdb.version if rc_coll is not None else 0
             ckey = (cname, q, n, s, fmt, ver)
             hit = self._result_cache.get(ckey)
             if hit is not None:
                 self.stats["result_cache_hits"] = \
                     self.stats.get("result_cache_hits", 0) + 1
+                trace_mod.tag(result_cache="hit")
                 return hit
         if self.cluster is not None:
             # conf is only consulted for PQR factors — never create a
@@ -511,7 +565,9 @@ class SearchHTTPServer:
             with self._lock:
                 res = engine.search(self._coll(query), q, topk=n,
                                     offset=s)
-        payload, ctype = render_results(res, fmt)
+        payload, ctype = render_results(
+            res, fmt,
+            trace_id=tr.trace_id if (debug and tr is not None) else None)
         if ckey is not None:
             self._result_cache.put(ckey, (200, payload, ctype),
                                    ttl_s=ttl)
@@ -654,7 +710,7 @@ class SearchHTTPServer:
         links = "".join(
             f'<li><a href="/admin/{p}{sfx}">{p}</a></li>'
             for p in ("stats", "hosts", "perf", "mem", "transport",
-                      "parms", "profiler", "graph"))
+                      "traces", "parms", "profiler", "graph"))
         rows = "".join(f"<tr><td>{k}</td><td>{v}</td></tr>"
                        for k, v in self.stats.items())
         colls = ", ".join(self.colldb.names())
@@ -734,6 +790,101 @@ class SearchHTTPServer:
                     "addrs": self.cluster.conf.addresses[s],
                 } for s in range(hm.n_shards)}
         return 200, json.dumps(body), "application/json"
+
+    #: waterfall bar palette — one color per host, assigned by hash so
+    #: the same host colors the same across traces
+    _TRACE_COLORS = ("#4c78a8", "#f58518", "#54a24b", "#e45756",
+                     "#72b7b2", "#b279a2", "#eeca3b", "#9d755d")
+
+    def _page_traces(self, query: dict) -> tuple[int, str, str]:
+        """Recent sampled traces + the slow-query log, with a per-trace
+        waterfall (nested HTML bars, offsets/widths proportional to the
+        span's place in the trace, colored by host/shard).
+
+        ``?id=<trace_id>`` shows one trace; ``?format=json`` returns
+        the raw ring + slowlog tail."""
+        recent = g_tracer.recent()
+        slowlog = g_tracer.slowlog_tail(50)
+        if query.get("format") == "json":
+            return 200, json.dumps(
+                {"recent": recent, "slowlog": slowlog,
+                 "sample_n": g_tracer.sample_n,
+                 "slow_ms": g_tracer.slow_ms}), "application/json"
+        tid = query.get("id", "")
+        if tid:
+            tr = g_tracer.find(tid) or next(
+                (t for t in reversed(slowlog)
+                 if t.get("trace_id") == tid), None)
+            if tr is None:
+                return 404, json.dumps({"error": "no such trace"}), \
+                    "application/json"
+            return 200, (
+                "<html><head><title>trace</title></head><body>"
+                f"{self._trace_waterfall(tr)}"
+                '<p><a href="/admin/traces">all traces</a></p>'
+                "</body></html>"), "text/html"
+        blocks = "".join(self._trace_waterfall(t)
+                         for t in reversed(recent[-20:]))
+        slows = "".join(
+            f'<tr><td><a href="/admin/traces?id='
+            f'{html_mod.escape(str(t.get("trace_id", "")))}">'
+            f'{html_mod.escape(str(t.get("trace_id", "")))}</a></td>'
+            f'<td>{html_mod.escape(str((t.get("root") or {}).get("tags", {}).get("q", "")))}</td>'
+            f'<td>{t.get("dur_ms", 0):.1f}</td></tr>'
+            for t in reversed(slowlog)) \
+            or "<tr><td colspan=3>empty</td></tr>"
+        return 200, (
+            "<html><head><title>gb traces</title></head><body>"
+            "<h1>traces</h1>"
+            f"<p>sampling 1/{g_tracer.sample_n} &middot; slow &ge; "
+            f"{g_tracer.slow_ms:.0f} ms &middot; ring "
+            f"{len(recent)}</p>"
+            "<h2>slow queries (slowlog.jsonl)</h2>"
+            "<table border=1><tr><th>trace</th><th>q</th>"
+            f"<th>ms</th></tr>{slows}</table>"
+            f"<h2>recent traces</h2>{blocks}"
+            "</body></html>"), "text/html"
+
+    def _trace_waterfall(self, tr: dict) -> str:
+        """One trace → nested HTML bars. Bar offset/width are percent
+        of the trace duration; color keys on the span's host."""
+        total = max(float(tr.get("dur_ms", 0.0)), 1e-3)
+        rows: list[str] = []
+
+        def color(host: str) -> str:
+            return self._TRACE_COLORS[hash(host) %
+                                      len(self._TRACE_COLORS)]
+
+        def walk(node: dict, depth: int) -> None:
+            left = 100.0 * max(float(node.get("start_ms", 0.0)), 0.0) \
+                / total
+            width = min(100.0 - left,
+                        100.0 * float(node.get("dur_ms", 0.0)) / total)
+            host = str(node.get("host", ""))
+            tags = node.get("tags") or {}
+            tagstr = " ".join(f"{k}={v}" for k, v in tags.items())
+            label = html_mod.escape(
+                f"{node.get('name', '?')} {node.get('dur_ms', 0):.2f}ms"
+                + (f" [{host}]" if host else "")
+                + (f" {tagstr}" if tagstr else ""))
+            rows.append(
+                f'<div style="position:relative;height:16px;'
+                f'margin-left:{depth * 12}px">'
+                f'<div title="{label}" style="position:absolute;'
+                f"left:{left:.2f}%;width:{max(width, 0.2):.2f}%;"
+                f"height:14px;background:{color(host)};"
+                f'overflow:hidden;font-size:10px;color:#fff;'
+                f'white-space:nowrap">{label}</div></div>')
+            for c in node.get("children", []):
+                walk(c, depth + 1)
+
+        root = tr.get("root") or {}
+        walk(root, 0)
+        head = (f'trace <b>{html_mod.escape(str(tr.get("trace_id")))}'
+                f"</b> &middot; {total:.1f} ms"
+                + (" &middot; <b>slow</b>" if tr.get("slow") else ""))
+        return (f'<div style="border:1px solid #ccc;margin:8px;'
+                f'padding:4px"><p>{head}</p>{"".join(rows)}</div>')
 
     def _page_profiler(self, query: dict) -> tuple[int, str, str]:
         """Per-stage timing table + on-demand SAMPLING profiler (the
@@ -851,12 +1002,19 @@ class SearchHTTPServer:
             return
         try:
             lines = self._statsdb_path.read_text(
-                encoding="utf-8").splitlines()[-500:]
-            for line in lines:
+                encoding="utf-8", errors="replace").splitlines()[-500:]
+        except OSError:
+            return
+        # per-line tolerance: a kill-9 mid-append leaves ONE torn line;
+        # it must cost one sample, not the whole ring
+        for line in lines:
+            if not line.strip():
+                continue
+            try:
                 t, m = json.loads(line)
-                g_stats.timeseries.append((t, m))
-        except Exception:  # noqa: BLE001 — torn tail line etc.
-            pass
+                g_stats.timeseries.append((float(t), m))
+            except Exception:  # noqa: BLE001 — torn/corrupt line
+                g_stats.count("statsdb.corrupt_lines")
 
     def _page_hosts(self) -> str:
         """Shard/cluster map (PageHosts.cpp)."""
@@ -922,6 +1080,7 @@ class SearchHTTPServer:
                 do_handshake_on_connect=False)
             log.info("TLS enabled (cert=%s)", cert)
         self.port = self._httpd.server_address[1]  # resolve port 0
+        g_tracer.configure(host=f"{self.host}:{self.port}")
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()

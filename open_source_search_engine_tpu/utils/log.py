@@ -23,14 +23,29 @@ LOG_TYPES = (
 _configured = False
 
 
+class _TraceIdFilter(logging.Filter):
+    """Stamp each record with the active trace id (``utils.trace``
+    contextvar) so coordinator and node log lines for one query grep
+    together by id. Outside a trace the prefix collapses to nothing
+    and the line format is unchanged."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        from . import trace  # late: log must import before tracing does
+        tid = trace.current_trace_id()
+        record.traceid = f" [{tid}]" if tid else ""
+        return True
+
+
 def _configure() -> None:
     global _configured
     if _configured:
         return
     handler = logging.StreamHandler(sys.stderr)
     handler.setFormatter(
-        logging.Formatter("%(asctime)s %(name)s %(levelname).1s %(message)s")
+        logging.Formatter(
+            "%(asctime)s %(name)s %(levelname).1s%(traceid)s %(message)s")
     )
+    handler.addFilter(_TraceIdFilter())
     root = logging.getLogger(_ROOT)
     root.addHandler(handler)
     root.setLevel(logging.INFO)

@@ -66,6 +66,8 @@ PARMS: list[Parm] = [
     _p("serve_device", "sdev", bool, True, GLOBAL, "serve /search from the HBM-resident index with micro-batching (SURVEY §7.8 throughput mode)"),
     _p("merge_quiet_hours", "mergehours", str, "", GLOBAL, "DailyMerge window (DailyMerge.h:11)"),
     _p("alert_cmd", "alertcmd", str, "", GLOBAL, "command run on host death/recovery with OSSE_ALERT_* env (PingServer.h:77 email/SMS role); empty = log only", broadcast=False),
+    _p("trace_sample", "tsample", int, 64, GLOBAL, "head-sample 1 in N query traces (utils.trace, Dapper-style); 1 = every query, 0 = tracing off"),
+    _p("slow_query_ms", "slowms", float, 1000.0, GLOBAL, "queries slower than this keep their trace regardless of sampling and land in slowlog.jsonl"),
     # --- per-collection (coll.conf / CollectionRec) ---
     _p("docs_wanted", "n", int, 10, COLL, "results per page (SearchInput 'n')"),
     _p("site_cluster", "sc", bool, True, COLL, "max-2-per-site clustering (Msg51/Clusterdb)"),

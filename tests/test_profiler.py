@@ -1,0 +1,84 @@
+"""Sampling profiler tests (``utils.profiler``, Profiler.cpp analog).
+
+Pins the contract /admin/profiler relies on: start/stop are idempotent
+(double-start keeps ONE sampler thread, double-stop is safe), a busy
+thread's frames show up in both the self and cumulative histograms,
+and reset() zeroes the aggregation without touching a running sampler.
+"""
+
+import threading
+import time
+
+from open_source_search_engine_tpu.utils.profiler import SamplingProfiler
+
+
+def _burn_inner(n=20_000):
+    x = 0
+    for i in range(n):
+        x += i * i
+    return x
+
+
+def _burn_loop(stop):
+    while not stop.is_set():
+        _burn_inner()
+
+
+def test_start_stop_idempotent():
+    p = SamplingProfiler(interval_s=0.002)
+    assert not p.running
+    p.stop()  # stop before any start: no-op
+    p.start()
+    first = p._thread
+    p.start()  # second start keeps the SAME sampler thread
+    assert p._thread is first and p.running
+    p.stop()
+    assert not p.running and p._thread is None
+    p.stop()  # double-stop: no-op
+
+
+def test_busy_thread_frames_aggregated():
+    p = SamplingProfiler(interval_s=0.001)
+    stop = threading.Event()
+    th = threading.Thread(target=_burn_loop, args=(stop,), daemon=True)
+    th.start()
+    p.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while p.samples < 20 and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        p.stop()
+        stop.set()
+        th.join(2.0)
+    assert p.samples >= 20
+    cum_funcs = {k[0] for k in p.cum_hits}
+    assert "_burn_loop" in cum_funcs
+    # the leaf shows up as SELF time, and the report carries the frac
+    self_funcs = {k[0] for k in p.self_hits}
+    assert "_burn_inner" in self_funcs
+    rep = p.report()
+    assert rep["samples"] == p.samples and not rep["running"]
+    assert any(r["func"] == "_burn_inner" and r["hits"] > 0
+               for r in rep["top_self"])
+    assert all(0.0 <= r["frac"] <= 1.0 for r in rep["top_cumulative"])
+
+
+def test_reset_zeroes_aggregation():
+    p = SamplingProfiler(interval_s=0.001)
+    stop = threading.Event()
+    th = threading.Thread(target=_burn_loop, args=(stop,), daemon=True)
+    th.start()
+    p.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while p.samples < 5 and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        p.stop()
+        stop.set()
+        th.join(2.0)
+    assert p.samples >= 5
+    p.reset()
+    assert p.samples == 0 and not p.self_hits and not p.cum_hits
+    assert p.report()["top_self"] == []

@@ -1,0 +1,397 @@
+"""Distributed tracing plane tests — span trees across shards.
+
+Pins the tentpole behaviors of :mod:`..utils.trace`: head-based
+sampling with a slow-query escape hatch, span trees assembled across
+threads via explicit parent handoff, cross-host propagation
+(``X-OSSE-Trace`` header out, ``"_trace"`` subtree back, grafted and
+rebased client-side), the slowlog file, and the acceptance scenario —
+a 2-shard cluster with a wedged primary produces ONE assembled trace
+holding both shards' ``rpc/search`` legs with the hedge winner tagged.
+Plus the lint guard: no bare ``g_stats.timed`` left on the query path
+(``trace.timed_span`` feeds both planes so they cannot drift).
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from open_source_search_engine_tpu.parallel import cluster as cl
+from open_source_search_engine_tpu.utils import trace as tm
+from open_source_search_engine_tpu.utils.stats import g_stats
+from open_source_search_engine_tpu.utils.trace import g_tracer
+
+
+@pytest.fixture(autouse=True)
+def _tracer_guard():
+    """g_tracer is process-global: save/restore its config and ring so
+    these tests can't leak sampling or slowlog paths into the suite."""
+    saved = (g_tracer.sample_n, g_tracer.slow_ms,
+             g_tracer.slowlog_path, g_tracer.host)
+    yield
+    g_tracer.sample_n, g_tracer.slow_ms = saved[0], saved[1]
+    g_tracer.slowlog_path, g_tracer.host = saved[2], saved[3]
+    g_tracer.ring.clear()
+
+
+def _walk(node):
+    yield node
+    for c in node.get("children", ()):
+        yield from _walk(c)
+
+
+def _doc(i, words="cluster shared words"):
+    return (f"<html><head><title>Doc {i}</title></head><body>"
+            f"<p>{words} token{i}.</p></body></html>")
+
+
+# ---------------------------------------------------------------------------
+# span trees + sampling
+# ---------------------------------------------------------------------------
+
+class TestSpanTree:
+    def test_nested_spans_export_as_tree(self):
+        g_tracer.configure(sample_n=1, slow_ms=1e9)
+        with g_tracer.start("q", sampled=True, q="hello") as t:
+            with tm.span("outer", k=1):
+                with tm.span("inner"):
+                    tm.tag(deep=True)
+                tm.record("pre", time.perf_counter() - 0.001)
+            assert tm.current_trace_id() == t.trace_id
+        tr = g_tracer.find(t.trace_id)
+        assert tr is not None and tr["sampled"]
+        names = [n["name"] for n in _walk(tr["root"])]
+        assert names == ["q", "outer", "inner", "pre"]
+        inner = next(n for n in _walk(tr["root"]) if n["name"] == "inner")
+        assert inner["tags"]["deep"] is True
+        outer = next(n for n in _walk(tr["root"]) if n["name"] == "outer")
+        # child offsets are ms from trace start, nested inside parent
+        assert outer["start_ms"] >= 0.0
+        assert inner["start_ms"] >= outer["start_ms"]
+        assert tr["root"]["tags"]["q"] == "hello"
+
+    def test_unsampled_trace_spans_are_noops(self):
+        g_tracer.configure(sample_n=10 ** 9, slow_ms=1e9)
+        g_tracer.ring.clear()
+        with g_tracer.start("q") as t:
+            assert t is not None and not t.sampled
+            with tm.span("work") as sp:
+                assert sp is None          # span bookkeeping skipped...
+            assert tm.current_span() is None
+            assert tm.current_trace_id() == t.trace_id  # ...id still set
+        assert g_tracer.find(t.trace_id) is None  # dropped, not kept
+
+    def test_head_sampling_one_in_n(self):
+        g_tracer.configure(sample_n=4, slow_ms=1e9)
+        g_tracer.ring.clear()
+        g_tracer._n = 0
+        for _ in range(8):
+            with g_tracer.start("q"):
+                pass
+        assert len(g_tracer.ring) == 2  # kept exactly 1 in 4
+
+    def test_sample_n_zero_disables_tracing(self):
+        g_tracer.configure(sample_n=0)
+        with g_tracer.start("q", sampled=True) as t:
+            assert t is None
+            assert tm.current_trace_id() is None
+
+    def test_abandoned_span_tagged_on_export(self):
+        g_tracer.configure(sample_n=1, slow_ms=1e9)
+        with g_tracer.start("q", sampled=True) as t:
+            leak = t.root.child("never-finished")
+        tr = g_tracer.find(t.trace_id)
+        node = next(n for n in _walk(tr["root"])
+                    if n["name"] == "never-finished")
+        assert node["tags"]["abandoned"] is True
+        assert leak._t1 is None
+
+    def test_explicit_parent_crosses_threads(self):
+        """begin(parent=...) + attach(): the pattern the cluster client
+        and batchers use to carry a trace into pool threads."""
+        g_tracer.configure(sample_n=1, slow_ms=1e9)
+        with g_tracer.start("q", sampled=True) as t:
+            leg = tm.begin("leg", parent=t.root, addr="x")
+
+            def worker():
+                assert tm.current_span() is None  # fresh ctx is empty
+                with tm.attach(leg):
+                    with tm.span("inside"):
+                        pass
+            th = threading.Thread(target=worker)
+            th.start()
+            th.join()
+            leg.finish()
+        tr = g_tracer.find(t.trace_id)
+        names = [n["name"] for n in _walk(tr["root"])]
+        assert names == ["q", "leg", "inside"]
+
+
+# ---------------------------------------------------------------------------
+# header + graft
+# ---------------------------------------------------------------------------
+
+class TestPropagation:
+    def test_header_roundtrip(self):
+        sp = tm.Span("abcd1234", "rpc/search")
+        hdr = tm.header_for(sp)
+        assert hdr == f"abcd1234:{sp.span_id}"
+        assert tm.parse_header(hdr) == ("abcd1234", sp.span_id)
+        assert tm.header_for(None) is None
+        assert tm.parse_header("") is None
+        assert tm.parse_header("no-colon") is None
+
+    def test_graft_rebases_remote_offsets_onto_rpc_span(self):
+        """Remote subtree offsets are relative to the REMOTE root; the
+        export shifts them by the local RPC span's start so no two
+        hosts' clocks are ever compared."""
+        root = tm.Span("t1", "q")
+        time.sleep(0.005)
+        rpc = root.child("rpc/search")
+        rpc.graft({"name": "remote", "host": "n1", "start_ms": 0.0,
+                   "dur_ms": 2.0, "tags": {},
+                   "children": [{"name": "inner", "host": "n1",
+                                 "start_ms": 1.5, "dur_ms": 0.5,
+                                 "tags": {}}]})
+        rpc.finish()
+        root.finish()
+        d = root.to_dict(root._t0, root._t1)
+        rpc_d = d["children"][0]
+        remote = rpc_d["children"][0]
+        assert remote["start_ms"] == pytest.approx(
+            rpc_d["start_ms"], abs=0.01)
+        assert remote["children"][0]["start_ms"] == pytest.approx(
+            rpc_d["start_ms"] + 1.5, abs=0.01)
+        assert tm.span_count(d) == 4
+
+
+# ---------------------------------------------------------------------------
+# slowlog
+# ---------------------------------------------------------------------------
+
+class TestSlowlog:
+    def test_slow_unsampled_trace_kept_and_logged(self, tmp_path):
+        path = tmp_path / "slowlog.jsonl"
+        g_tracer.configure(sample_n=10 ** 9, slow_ms=1.0,
+                           slowlog_path=path)
+        g_tracer.ring.clear()
+        with g_tracer.start("q", q="slowone") as t:
+            time.sleep(0.01)
+        tr = g_tracer.find(t.trace_id)
+        assert tr is not None and tr["slow"] and not tr["sampled"]
+        entries = [json.loads(x) for x in
+                   path.read_text().splitlines()]
+        assert len(entries) == 1
+        assert entries[0]["trace_id"] == t.trace_id
+        assert entries[0]["dur_ms"] >= 1.0
+        # unsampled slow trace keeps only the root skeleton
+        assert "children" not in entries[0]["root"]
+
+    def test_slowlog_tail_skips_torn_lines(self, tmp_path):
+        path = tmp_path / "slowlog.jsonl"
+        good = {"trace_id": "aa", "dur_ms": 5.0, "root": {}}
+        path.write_text(json.dumps(good) + "\n" +
+                        '{"trace_id": "bb", "dur_')  # kill-9 mid-append
+        g_tracer.configure(slowlog_path=path)
+        tail = g_tracer.slowlog_tail()
+        assert tail == [good]
+
+
+# ---------------------------------------------------------------------------
+# acceptance: cross-host tree with the hedge winner tagged
+# ---------------------------------------------------------------------------
+
+def test_cluster_trace_spans_both_shards_and_tags_hedge_winner(tmp_path):
+    """2 shards x 2 twins; shard0's primary twin wedges on the search,
+    the hedge fires, and the coordinator's SINGLE assembled trace holds
+    both shards' rpc/search legs, with the shard0 winner tagged
+    hedge_won and each node's grafted subtree carrying its host label."""
+    nodes = {name: cl.ShardNodeServer(tmp_path / name)
+             for name in ("a", "b", "c", "d")}
+    for node in nodes.values():
+        for i in range(3):
+            node.handle("/rpc/index", {"url": f"http://t.test/h{i}",
+                                       "content": _doc(i)})
+        node.start()
+    a, b, c, d = (nodes[k] for k in "abcd")
+    # hosts.conf layout: replica-0 rows first — shard0 twins are (a, c)
+    conf = cl.HostsConf.parse(
+        f"num-mirrors: 1\n127.0.0.1:{a.port}\n127.0.0.1:{b.port}\n"
+        f"127.0.0.1:{c.port}\n127.0.0.1:{d.port}")
+    client = cl.ClusterClient(conf, use_heartbeat=False)
+
+    wedge = threading.Event()
+    real_handle = a.handle
+
+    def wedged_handle(path, payload):
+        if path == "/rpc/search":
+            wedge.wait(10.0)
+        return real_handle(path, payload)
+
+    a.handle = wedged_handle
+    # pin the WEDGED node as shard0's primary pick; shard1 stays sane
+    client.hostmap.rtt_s[0, 0] = 0.001
+    client.hostmap.rtt_s[0, 1] = 0.002
+    client.hostmap.rtt_s[1, 0] = 0.001
+    client.hostmap.rtt_s[1, 1] = 0.002
+    g_stats.reset()
+    g_tracer.configure(sample_n=1, slow_ms=1e9)
+    g_tracer.ring.clear()
+    try:
+        with g_tracer.start("search", sampled=True) as t:
+            res = client.search("cluster shared", topk=5,
+                                with_snippets=False, site_cluster=False)
+        assert not res.degraded and res.total_matches > 0
+        snap = g_stats.snapshot()["counters"]
+        assert snap["transport.hedge_fired"] >= 1
+        assert snap["transport.hedge_won"] >= 1
+
+        tr = g_tracer.find(t.trace_id)
+        assert tr is not None
+        spans = list(_walk(tr["root"]))
+
+        # both shards' rpc/search legs live in ONE tree
+        legs = [s for s in spans if s["name"] == "rpc/search"
+                and "addr" in s["tags"]]
+        leg_ports = {int(s["tags"]["addr"].rsplit(":", 1)[1])
+                     for s in legs}
+        assert leg_ports & {a.port, c.port}, "no shard0 leg"
+        assert leg_ports & {b.port, d.port}, "no shard1 leg"
+
+        # the shard0 winner is the HEDGE attempt, tagged as such
+        winners = [s for s in legs if s["tags"].get("won")]
+        shard0_win = [s for s in winners
+                      if s["tags"]["addr"].endswith(str(c.port))]
+        assert shard0_win and shard0_win[0]["tags"]["hedge_won"] is True
+        assert shard0_win[0]["tags"]["hedge"] is True
+
+        # each answering node shipped its subtree back: grafted spans
+        # carry the remote host label and node-side work
+        remote_hosts = {s["host"] for s in spans
+                        if s["host"].startswith("127.0.0.1:")}
+        assert f"127.0.0.1:{c.port}" in remote_hosts
+        assert remote_hosts & {f"127.0.0.1:{b.port}",
+                               f"127.0.0.1:{d.port}"}
+        remote_roots = [s for s in spans
+                        if s["host"] == f"127.0.0.1:{c.port}"
+                        and s["name"] == "rpc/search"
+                        and "parent" in s["tags"]]
+        assert remote_roots, "no grafted subtree from the hedge winner"
+    finally:
+        wedge.set()
+        client.close()
+        for node in nodes.values():
+            node.stop()
+
+
+# ---------------------------------------------------------------------------
+# serving: debug echo, /admin/traces, slowlog end-to-end, statsdb
+# ---------------------------------------------------------------------------
+
+def _get(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode("utf-8", "replace")
+
+
+def test_slow_query_lands_in_slowlog_and_renders(tmp_path):
+    from open_source_search_engine_tpu.serve.server import \
+        SearchHTTPServer
+    srv = SearchHTTPServer(tmp_path, port=0)
+    srv.start()
+    # every query is slow at a 0.01ms threshold; sample everything
+    g_tracer.configure(sample_n=1, slow_ms=0.01)
+    g_tracer.ring.clear()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        out = json.loads(_get(f"{base}/search?q=anything&format=json"
+                              f"&debug=1"))
+        tid = out["traceId"]
+        assert re.fullmatch(r"[0-9a-f]{16}", tid)
+
+        slowlog = tmp_path / "slowlog.jsonl"
+        assert slowlog.exists()
+        assert any(json.loads(x)["trace_id"] == tid
+                   for x in slowlog.read_text().splitlines())
+
+        page = _get(f"{base}/admin/traces")
+        assert tid in page and "slowlog.jsonl" in page
+        water = _get(f"{base}/admin/traces?id={tid}")
+        assert tid in water and "search" in water
+
+        body = json.loads(_get(f"{base}/admin/traces?format=json"))
+        assert any(t["trace_id"] == tid for t in body["recent"])
+        assert any(t["trace_id"] == tid for t in body["slowlog"])
+    finally:
+        srv.stop()
+
+
+def test_debug_echo_only_when_asked(tmp_path):
+    from open_source_search_engine_tpu.serve.server import \
+        SearchHTTPServer
+    srv = SearchHTTPServer(tmp_path, port=0)
+    srv.start()
+    g_tracer.configure(sample_n=10 ** 9, slow_ms=1e9)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        out = json.loads(_get(f"{base}/search?q=x&format=json"))
+        assert "traceId" not in out
+        xml = _get(f"{base}/search?q=x&format=xml&debug=1")
+        assert "<traceId>" in xml
+    finally:
+        srv.stop()
+
+
+def test_statsdb_corrupt_lines_tolerated(tmp_path):
+    """A torn/garbage statsdb line is counted and skipped; the good
+    samples still load (satellite: crash-consistent statsdb reload)."""
+    from open_source_search_engine_tpu.serve.server import \
+        SearchHTTPServer
+    good = json.dumps([time.time(), {"qps": 1.0}])
+    (Path(tmp_path) / "statsdb.jsonl").write_text(
+        good + "\n" + "{torn json li\n" + "\n" + good + "\n")
+    srv = SearchHTTPServer(tmp_path, port=0)
+    g_stats.reset()
+    g_stats.timeseries.clear()
+    srv._load_statsdb()
+    assert len(g_stats.timeseries) == 2
+    assert g_stats.snapshot()["counters"]["statsdb.corrupt_lines"] == 1
+
+
+# ---------------------------------------------------------------------------
+# lint: the two timing planes cannot drift
+# ---------------------------------------------------------------------------
+
+def test_query_path_has_no_bare_g_stats_timed():
+    """Every query-path timer must be a trace.timed_span (which feeds
+    g_stats AND the trace) — a bare g_stats.timed would time a stage
+    the waterfall can't see."""
+    pkg = Path(cl.__file__).resolve().parent.parent
+    offenders = []
+    for rel in ("query", "parallel", "serve"):
+        for py in sorted((pkg / rel).glob("*.py")):
+            for i, line in enumerate(py.read_text().splitlines(), 1):
+                if re.search(r"\bg_stats\.timed\(", line):
+                    offenders.append(f"{py.name}:{i}")
+    assert not offenders, (
+        f"bare g_stats.timed on the query path (use trace.timed_span): "
+        f"{offenders}")
+
+
+def test_timed_span_feeds_both_planes():
+    g_tracer.configure(sample_n=1, slow_ms=1e9)
+    g_stats.reset()
+    with g_tracer.start("q", sampled=True) as t:
+        with tm.timed_span("stage.x"):
+            pass
+    tr = g_tracer.find(t.trace_id)
+    assert any(n["name"] == "stage.x" for n in _walk(tr["root"]))
+    assert "stage.x" in g_stats.snapshot()["latencies"]
+    # outside any trace the stats half still records
+    g_stats.reset()
+    with tm.timed_span("stage.y"):
+        pass
+    assert "stage.y" in g_stats.snapshot()["latencies"]
