@@ -307,6 +307,95 @@ def main_transport() -> None:
     }))
 
 
+def main_cache() -> None:
+    """Cache-plane microbench (BENCH_CACHE=1): a fixed-seed Zipf query
+    replay through a 2-node in-process ClusterClient, run twice — cache
+    plane on vs off (``use_cache``/``enabled`` A/B levers). Reports the
+    front-cache hit rate and the p50 of REPEATED queries (a query's
+    second and later occurrences — the population a result cache
+    exists for) cached vs uncached. Loopback/CPU numbers; the point is
+    the relative spread."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import random
+
+    from open_source_search_engine_tpu.cache import g_cacheplane
+    from open_source_search_engine_tpu.parallel import cluster as cl
+
+    bdir = tempfile.mkdtemp(prefix="osse_bench_cache_")
+    n_docs = int(os.environ.get("BENCH_CACHE_DOCS", "40"))
+    n_q = int(os.environ.get("BENCH_CACHE_QUERIES", "200"))
+    vocab = ("alpha bravo charlie delta echo foxtrot golf hotel india "
+             "juliet kilo lima mike november oscar papa quebec romeo "
+             "sierra tango uniform victor whiskey yankee").split()
+    nodes = []
+    for i in range(2):
+        node = cl.ShardNodeServer(os.path.join(bdir, f"n{i}"))
+        for d in range(n_docs):
+            words = " ".join(vocab[(d + j) % len(vocab)]
+                             for j in range(6))
+            node.handle("/rpc/index", {
+                "url": f"http://bench.test/{i}-{d}",
+                "content": (f"<html><body><p>{words} filler "
+                            f"token{d}</p></body></html>")})
+        node.start()
+        nodes.append(node)
+    conf = cl.HostsConf.parse(
+        "num-mirrors: 0\n"
+        + "\n".join(f"127.0.0.1:{n.port}" for n in nodes))
+
+    # fixed-seed Zipf(s=1.1) mix over a small distinct-query set: a
+    # few hot heads, a long-ish tail — the SERP traffic shape a result
+    # cache lives on
+    distinct = ([w for w in vocab[:12]]
+                + [f"{vocab[i]} {vocab[(i * 7 + 3) % len(vocab)]}"
+                   for i in range(12)])
+    weights = [1.0 / (r + 1) ** 1.1 for r in range(len(distinct))]
+    stream = random.Random(6).choices(distinct, weights=weights, k=n_q)
+
+    def pct(lats, q):
+        return lats[min(len(lats) - 1, int(len(lats) * q))]
+
+    def replay(use_cache: bool) -> dict:
+        g_cacheplane.flush()
+        for n in nodes:
+            n._search_cache.enabled = use_cache
+        client = cl.ClusterClient(conf, use_heartbeat=False,
+                                  use_cache=use_cache)
+        seen: set = set()
+        repeat_lats = []
+        t0 = time.perf_counter()
+        for q in stream:
+            q0 = time.perf_counter()
+            client.search(q, topk=10)
+            dt = 1000.0 * (time.perf_counter() - q0)
+            if q in seen:
+                repeat_lats.append(dt)
+            seen.add(q)
+        wall = time.perf_counter() - t0
+        st = client._result_cache.stats()
+        client.close()
+        repeat_lats.sort()
+        return {"qps": round(n_q / wall, 1),
+                "repeat_p50_ms": round(pct(repeat_lats, 0.50), 3),
+                "repeat_p99_ms": round(pct(repeat_lats, 0.99), 3),
+                "front_hit_rate": round(st["hit_rate"], 3)}
+
+    # warmup absorbs JAX compiles so neither timed run pays them
+    replay(use_cache=False)
+    uncached = replay(use_cache=False)
+    cached = replay(use_cache=True)
+    for n in nodes:
+        n.stop()
+    speedup = round(uncached["repeat_p50_ms"]
+                    / max(cached["repeat_p50_ms"], 1e-9), 2)
+    print(json.dumps({
+        "metric": "cache_hot_query_p50_speedup",
+        "value": speedup, "unit": "x", "vs_baseline": speedup,
+        "queries": n_q, "distinct": len(distinct),
+        "cached": cached, "uncached": uncached,
+    }))
+
+
 def main_trace() -> None:
     """Tracing-plane microbench (BENCH_TRACE=1): the cost of leaving
     the tracer ON in production. A/B on the host (CPU) query path:
@@ -653,6 +742,8 @@ if __name__ == "__main__":
         main_mesh(int(os.environ["BENCH_MESH"]))
     elif os.environ.get("BENCH_TRANSPORT"):
         main_transport()
+    elif os.environ.get("BENCH_CACHE"):
+        main_cache()
     elif os.environ.get("BENCH_TRACE"):
         main_trace()
     else:

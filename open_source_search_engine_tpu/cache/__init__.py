@@ -1,0 +1,12 @@
+"""Cache plane — the RdbCache role as one subsystem.
+
+Gigablast put a single cache class behind every hot lookup (termlists,
+title recs, DNS, robots, the Msg17 result cache); this package is that
+idea for the TPU port: a registry of named, membudget-charged caches
+with generation-based invalidation and single-flight miss suppression.
+See :mod:`.plane`.
+"""
+
+from .plane import CachePlane, GenCache, g_cacheplane
+
+__all__ = ["CachePlane", "GenCache", "g_cacheplane"]

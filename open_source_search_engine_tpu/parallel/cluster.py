@@ -47,6 +47,7 @@ from pathlib import Path
 
 import numpy as np
 
+from ..cache import g_cacheplane
 from ..index.collection import Collection
 from ..utils import ghash
 from ..utils import trace as trace_mod
@@ -127,7 +128,8 @@ class ShardNodeServer:
     """
 
     def __init__(self, data_dir: str | Path, host: str = "127.0.0.1",
-                 port: int = 0, use_device: bool = False):
+                 port: int = 0, use_device: bool = False,
+                 use_cache: bool = True):
         self.coll = Collection("shard", data_dir)
         # per-shard results feed the CLIENT-side merge, which applies
         # PostQueryRerank once over the merged page — node-side PQR
@@ -166,6 +168,18 @@ class ShardNodeServer:
         self._heal_buffer: list[dict] | None = None
         #: last applied parm-broadcast sequence per name (0x3f dedup)
         self._parm_seq: dict[str, int] = {}
+        #: per-shard search-result cache (the Msg39 leg of the RdbCache
+        #: story): normalized (total, docids, scores) per (q, topk,
+        #: lang), generation-keyed on posdb.version so any accepted
+        #: write invalidates everything in O(1). Checked inside
+        #: handle(), so coalesced batch riders hit it too.
+        _coll = self.coll
+        self._search_cache = g_cacheplane.register(
+            "node.search", ttl_s=30.0, max_entries=4096,
+            gen_fn=lambda: _coll.posdb.version,
+            desc="per-shard /rpc/search replies (Msg39 result cache)")
+        if not use_cache:
+            self._search_cache.enabled = False
 
     def _replay_journal(self) -> None:
         from ..build import docproc
@@ -228,8 +242,10 @@ class ShardNodeServer:
                 if ml is None:  # tagdb manualban — the DELIVERY
                     # succeeded (ok), the document was refused; ok=False
                     # would park the write and wedge the ordered queue
-                    return {"ok": True, "banned": True}
-                return {"ok": True, "docid": int(ml.docid)}
+                    return {"ok": True, "banned": True,
+                            "gen": self.coll.posdb.version}
+                return {"ok": True, "docid": int(ml.docid),
+                        "gen": self.coll.posdb.version}
             if path == "/rpc/remove":
                 self._journal_write({"op": "remove",
                                      "url": payload["url"]})
@@ -237,45 +253,81 @@ class ShardNodeServer:
                     self._heal_buffer.append({"op": "remove",
                                               "url": payload["url"]})
                 ok = docproc.remove_document(self.coll, payload["url"])
-                return {"ok": bool(ok)}
+                return {"ok": bool(ok),
+                        "gen": self.coll.posdb.version}
             if path == "/rpc/search":
                 topk = int(payload.get("topk", 10))
                 lang = int(payload.get("lang", 0))
+                # replies are cached per (q, topk, lang) under the
+                # CURRENT posdb generation — stable while we hold the
+                # writer lock, so a reply can never mix generations
+                gen = self.coll.posdb.version
                 if "queries" in payload:
                     # batched scatter-gather: the client coalesces
                     # concurrent callers per shard; one device dispatch
                     # (search_device_batch vmaps the whole batch)
-                    # instead of a request per query
+                    # instead of a request per query. Cache is checked
+                    # PER RIDER: a repeated query that coalesced into a
+                    # fresh batch still hits.
                     qs = [str(q) for q in payload["queries"]]
-                    if self.use_device:
-                        many = engine.search_device_batch(
-                            self.coll, qs, topk=topk, lang=lang,
-                            with_snippets=False, site_cluster=False)
-                    else:
-                        many = [engine.search(
-                            self.coll, q, topk=topk, lang=lang,
-                            with_snippets=False, site_cluster=False)
-                            for q in qs]
+                    entries: list = [None] * len(qs)
+                    miss = []
+                    for i, q in enumerate(qs):
+                        hit, e = self._search_cache.lookup(
+                            (q, topk, lang), gen=gen)
+                        if hit:
+                            entries[i] = e
+                        else:
+                            miss.append(i)
+                    if miss:
+                        mqs = [qs[i] for i in miss]
+                        if self.use_device:
+                            many = engine.search_device_batch(
+                                self.coll, mqs, topk=topk, lang=lang,
+                                with_snippets=False, site_cluster=False)
+                        else:
+                            many = [engine.search(
+                                self.coll, q, topk=topk, lang=lang,
+                                with_snippets=False, site_cluster=False)
+                                for q in mqs]
+                        for i, r in zip(miss, many):
+                            e = {"total": r.total_matches,
+                                 "docids": np.asarray(
+                                     [int(x.docid) for x in r.results],
+                                     dtype=np.int64),
+                                 "scores": np.asarray(
+                                     [float(x.score)
+                                      for x in r.results],
+                                     dtype=np.float64)}
+                            self._search_cache.put((qs[i], topk, lang),
+                                                   e, gen=gen)
+                            entries[i] = e
                     g_stats.count("transport.node_batched_q", len(qs))
-                    return {"ok": True, "results": [
-                        {"total": r.total_matches,
+                    return {"ok": True, "results": entries, "gen": gen}
+                q = str(payload["q"])
+                hit, e = self._search_cache.lookup((q, topk, lang),
+                                                   gen=gen)
+                if not hit:
+                    search = (engine.search_device if self.use_device
+                              else engine.search)
+                    res = search(self.coll, q, topk=topk,
+                                 lang=lang,
+                                 with_snippets=False,
+                                 site_cluster=False)
+                    e = {"total": res.total_matches,
                          "docids": np.asarray(
-                             [int(x.docid) for x in r.results],
+                             [int(r.docid) for r in res.results],
                              dtype=np.int64),
                          "scores": np.asarray(
-                             [float(x.score) for x in r.results],
+                             [float(r.score) for r in res.results],
                              dtype=np.float64)}
-                        for r in many]}
-                search = (engine.search_device if self.use_device
-                          else engine.search)
-                res = search(self.coll, payload["q"], topk=topk,
-                             lang=lang,
-                             with_snippets=False, site_cluster=False)
+                    self._search_cache.put((q, topk, lang), e, gen=gen)
                 return {
                     "ok": True,
-                    "total": res.total_matches,
-                    "docids": [int(r.docid) for r in res.results],
-                    "scores": [float(r.score) for r in res.results],
+                    "total": e["total"],
+                    "docids": [int(x) for x in e["docids"]],
+                    "scores": [float(x) for x in e["scores"]],
+                    "gen": gen,
                 }
             if path == "/rpc/doc":
                 from ..build.docproc import get_document
@@ -510,6 +562,13 @@ class ShardNodeServer:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                # every reply advertises this node's Rdb generation:
+                # the client cache plane folds it in (transport
+                # gen_observer) so even a read reply reveals that a
+                # write landed — no stale window beyond one in-flight
+                # read
+                self.send_header(transport_mod.GEN_HEADER,
+                                 str(outer.coll.posdb.version))
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -714,7 +773,8 @@ class ClusterClient:
     """Routes adds/reads/queries across the node processes."""
 
     def __init__(self, conf: HostsConf, use_heartbeat: bool = True,
-                 parms=None, transport: Transport | None = None):
+                 parms=None, transport: Transport | None = None,
+                 use_cache: bool = True):
         self.conf = conf
         #: optional global Conf (utils.parms) — supplies alert_cmd etc.
         self.parms = parms
@@ -722,6 +782,38 @@ class ClusterClient:
         #: pools, but any Transport (e.g. a JSON-only one) drops in
         self.transport = transport or Transport()
         self.hostmap = HostMap(conf.n_shards, conf.n_replicas)
+        # --- cache-plane generation tracking (per shard) -----------------
+        # A shard's generation is the PAIR (local write counter, highest
+        # node gen observed). The local counter bumps BEFORE a write is
+        # sent — dependent entries die the instant the write is
+        # initiated, not when the node acks, so there is no stale
+        # window. The node half folds in X-OSSE-Gen reply headers: a
+        # write from ANOTHER client shows up at our next read of any
+        # kind and invalidates our entries too.
+        self._gen_lock = threading.Lock()
+        self._gen_local = [0] * conf.n_shards
+        self._gen_node = [0] * conf.n_shards
+        self._addr_shard = {conf.addresses[s][r]: s
+                            for s in range(conf.n_shards)
+                            for r in range(conf.n_replicas)}
+        self.transport.gen_observer = self._observe_gen
+        #: per-(shard, query) leg cache: the Msg0/termlist-cache role —
+        #: one shard's raw top-k for one query; generation = that
+        #: shard's pair only, so a write on shard 1 never flushes
+        #: shard 0's legs
+        self._leg_cache = g_cacheplane.register(
+            "cluster.legs", ttl_s=30.0, max_entries=8192,
+            desc="per-shard raw search legs (Msg0 role)")
+        #: merged front result cache: the Msg17/Msg40Cache role — the
+        #: whole scatter-gather+merge+titlerec answer; generation = the
+        #: full shard-gen vector (any shard's write invalidates)
+        self._result_cache = g_cacheplane.register(
+            "cluster.results", ttl_s=30.0, max_entries=1024,
+            gen_fn=self.gen_vector,
+            desc="merged cluster SERPs (Msg17/Msg40Cache role)")
+        if not use_cache:
+            self._leg_cache.enabled = False
+            self._result_cache.enabled = False
         self._queues = {(s, r): _HostQueue()
                         for s in range(conf.n_shards)
                         for r in range(conf.n_replicas)}
@@ -753,7 +845,36 @@ class ClusterClient:
     def close(self) -> None:
         self._stop.set()
         self._pool.shutdown(wait=False)
+        if self.transport.gen_observer == self._observe_gen:
+            self.transport.gen_observer = None
         self.transport.close()
+
+    # --- cache-plane generations -----------------------------------------
+
+    def _observe_gen(self, addr: str, gen: int) -> None:
+        """Transport hook: an X-OSSE-Gen reply header from any node of
+        shard s raises that shard's observed node generation."""
+        s = self._addr_shard.get(addr)
+        if s is None:
+            return
+        with self._gen_lock:
+            if gen > self._gen_node[s]:
+                self._gen_node[s] = gen
+
+    def shard_gen(self, shard: int) -> tuple[int, int]:
+        with self._gen_lock:
+            return (self._gen_local[shard], self._gen_node[shard])
+
+    def gen_vector(self) -> tuple:
+        """All shards' generation pairs — the front result cache's
+        generation (equality-compared; any component moving kills
+        dependent entries)."""
+        with self._gen_lock:
+            return tuple(zip(self._gen_local, self._gen_node))
+
+    def _bump_local_gen(self, shard: int) -> None:
+        with self._gen_lock:
+            self._gen_local[shard] += 1
 
     @property
     def pending_writes(self) -> int:
@@ -928,6 +1049,9 @@ class ClusterClient:
     def index_document(self, url: str, content: str) -> int:
         docid = ghash.doc_id(url)
         shard = int(self.hostmap.shard_of_docid(docid))
+        # bump BEFORE sending: entries must be dead while the write is
+        # in flight (the no-stale-window half of the cache contract)
+        self._bump_local_gen(shard)
         self._write_all_twins(shard, "/rpc/index",
                               {"url": url, "content": content})
         return docid
@@ -935,6 +1059,7 @@ class ClusterClient:
     def remove_document(self, url: str) -> None:
         docid = ghash.doc_id(url)
         shard = int(self.hostmap.shard_of_docid(docid))
+        self._bump_local_gen(shard)
         self._write_all_twins(shard, "/rpc/remove", {"url": url})
 
     def save_all(self) -> None:
@@ -1003,10 +1128,25 @@ class ClusterClient:
         """One shard's leg of the scatter: rides the per-shard batcher
         so concurrent queries coalesce into one (hedged) RPC.
         ``parent_span`` carries the caller's trace across the
-        read-pool thread hop (contextvars don't follow threads)."""
-        return self._batchers[shard].submit(q, topk, lang,
-                                            SEARCH_TIMEOUT_S,
-                                            parent_span=parent_span)
+        read-pool thread hop (contextvars don't follow threads).
+
+        The leg cache is checked here with the shard's generation
+        captured BEFORE the RPC: a write racing the read moves the
+        generation, so the entry we store is already dead — correctness
+        over hit rate."""
+        key = (shard, q, topk, lang)
+        gen = self.shard_gen(shard)
+        hit, out = self._leg_cache.lookup(key, gen=gen)
+        if hit:
+            if parent_span is not None:
+                parent_span.tag(leg_cache="hit")
+            return out
+        out = self._batchers[shard].submit(q, topk, lang,
+                                           SEARCH_TIMEOUT_S,
+                                           parent_span=parent_span)
+        if out is not None and out.get("ok", True):
+            self._leg_cache.put(key, out, gen=gen)
+        return out
 
     def search_batch(self, queries: list[str], topk: int = 10,
                      lang: int = 0, with_snippets: bool = True,
@@ -1033,7 +1173,28 @@ class ClusterClient:
                with_snippets: bool = True, site_cluster: bool = True,
                offset: int = 0, conf=None):
         """Fan out to every shard's serving twin, merge top-k, then
-        fetch titlerecs from the owning shards (Msg20)."""
+        fetch titlerecs from the owning shards (Msg20).
+
+        Wrapped by the front result cache (Msg17/Msg40Cache role):
+        keyed on the full request shape, generation = the shard-gen
+        vector, single-flight so a stampede of one hot query runs the
+        scatter once."""
+        key = (q, topk, lang, with_snippets, site_cluster, offset,
+               id(conf) if conf is not None else 0)
+        out, _ = self._result_cache.get_or_compute(
+            key, lambda: self._search_uncached(
+                q, topk=topk, lang=lang, with_snippets=with_snippets,
+                site_cluster=site_cluster, offset=offset, conf=conf))
+        if getattr(out, "degraded", False):
+            # a partial answer (shard down) must not be pinned for a
+            # whole TTL — serve it once, recompute next time
+            self._result_cache.invalidate(key)
+        return out
+
+    def _search_uncached(self, q: str, topk: int = 10, lang: int = 0,
+                         with_snippets: bool = True,
+                         site_cluster: bool = True,
+                         offset: int = 0, conf=None):
         from ..query.compiler import compile_query
         from ..query.engine import (PQR_SCAN, SearchResults,
                                     build_results, finish_page)

@@ -38,6 +38,7 @@ import json
 import struct
 import threading
 import time
+from typing import Callable
 
 import numpy as np
 
@@ -46,6 +47,10 @@ from ..utils.log import get_logger
 from ..utils.stats import g_stats
 
 log = get_logger("transport")
+
+#: reply header carrying the answering node's Rdb generation (its posdb
+#: version) — the cache plane's cluster-wide invalidation signal
+GEN_HEADER = "X-OSSE-Gen"
 
 #: negotiated content type for the binary frame codec
 BIN_CONTENT_TYPE = "application/x-osse-bin"
@@ -246,6 +251,11 @@ class Transport:
         self.binary = binary
         self._peers: dict[str, _PeerState] = {}
         self._lock = threading.Lock()
+        #: optional hook ``fn(addr, gen)`` fed every ``X-OSSE-Gen``
+        #: reply header — nodes stamp their Rdb version on every reply
+        #: so the caller's cache plane observes generation moves even on
+        #: replies whose body carries no "gen" field (pings, reads)
+        self.gen_observer: Callable[[str, int], None] | None = None
 
     # --- pool -------------------------------------------------------------
 
@@ -428,6 +438,14 @@ class Transport:
                     f"{err.get('error', '')}".strip())
             self._observe(addr, path, time.monotonic() - t0)
             g_stats.count("transport.rpc")
+            obs = self.gen_observer
+            if obs is not None:
+                gen_hdr = resp.headers.get(GEN_HEADER)
+                if gen_hdr is not None:
+                    try:
+                        obs(addr, int(gen_hdr))
+                    except Exception:  # noqa: BLE001 — observer only
+                        pass
             return decode_body(data,
                                resp.headers.get("Content-Type", ""))
         raise AssertionError("unreachable")

@@ -478,6 +478,20 @@ class DeviceIndex:
         #: two-phase (f1), direct-cube (fd) and generic full-cube (f2)
         #: kernels (escalation reruns not counted)
         self.route_counts = {"f1": 0, "fd": 0, "f2": 0}
+        #: resident-plan cache (the termlist-cache role, RdbCache): the
+        #: per-query host planning pass — directory binary searches, df
+        #: lookups, slot planning, row layout — repeats byte-identically
+        #: for a repeated query until a write moves posdb or fielddb;
+        #: generation-keyed on both versions so invalidation is O(1).
+        #: Mutations of a cached plan's kappa_min/k2_min escalation
+        #: floors are deliberate: a hot query's learned floor persists.
+        from ..cache import g_cacheplane
+        _coll = coll
+        self._plan_cache = g_cacheplane.register(
+            f"devindex.plan.{coll.name}", ttl_s=300.0, max_entries=2048,
+            gen_fn=lambda: (_coll.posdb.version,
+                            _coll.fielddb.rdb.version),
+            desc="resident query plans (termlist-cache role)")
         self.refresh()
 
     def _put(self, a):
@@ -1319,9 +1333,24 @@ class DeviceIndex:
         t_plan = time.perf_counter()
         qplans = [q if isinstance(q, QueryPlan) else compile_query(q, lang)
                   for q in queries]
-        plans = [self.plan(qp, df_of=df_of, total_docs=total_docs,
-                           sort_base_of=sort_base_of)
-                 for qp in qplans]
+        # plan cache: only the pure-local form is cacheable — mesh calls
+        # override dfs/sort bases with cluster-wide values that change
+        # per caller and must not leak between planes
+        cacheable = (df_of is None and total_docs is None
+                     and sort_base_of is None)
+        if cacheable:
+            plans = []
+            for qp in qplans:
+                ck = (qp.raw, qp.lang)
+                hit, p = self._plan_cache.lookup(ck)
+                if not hit:
+                    p = self.plan(qp)
+                    self._plan_cache.put(ck, p)
+                plans.append(p)
+        else:
+            plans = [self.plan(qp, df_of=df_of, total_docs=total_docs,
+                               sort_base_of=sort_base_of)
+                     for qp in qplans]
         g_stats.record_ms("devindex.plan",
                           1000 * (time.perf_counter() - t_plan))
         trace.record("devindex.plan", t_plan, queries=len(qplans))

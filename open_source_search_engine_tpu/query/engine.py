@@ -34,6 +34,25 @@ import threading as _threading  # noqa: E402
 
 _DI_CREATE_LOCK = _threading.Lock()
 
+#: compiled-plan cache: compile_query is pure in (raw, lang) and
+#: QueryPlan is immutable after compile, so plans never invalidate —
+#: no generation, just TTL/LRU bounds (Query.cpp reparsed every time;
+#: we don't have to)
+from ..cache import g_cacheplane as _g_cacheplane  # noqa: E402
+
+_compiled_cache = _g_cacheplane.register(
+    "query.compiled", ttl_s=600.0, max_entries=4096,
+    desc="compiled QueryPlans, pure in (raw, lang)")
+
+
+def _compile_cached(q: str, lang: int) -> QueryPlan:
+    ck = (q, lang)
+    hit, plan = _compiled_cache.lookup(ck)
+    if not hit:
+        plan = compile_query(q, lang=lang)
+        _compiled_cache.put(ck, plan)
+    return plan
+
 
 #: site-clustering cap: at most this many results per site
 #: (reference Msg51/Msg40 "site clustering (max 2/site)", Msg51.h:96)
@@ -200,7 +219,7 @@ def search(coll: Collection, q: str | QueryPlan, *, topk: int = 10,
            site_cluster: bool = True, offset: int = 0) -> SearchResults:
     """Execute a query against one collection (single shard).
     ``offset`` = deep-paging start row (reference ``s=``)."""
-    plan = q if isinstance(q, QueryPlan) else compile_query(q, lang=lang)
+    plan = q if isinstance(q, QueryPlan) else _compile_cached(q, lang)
     raw = plan.raw
 
     g_stats.count("query")
@@ -374,7 +393,7 @@ def search_device_batch(coll: Collection, queries, *, topk: int = 10,
     """Batched resident-index search: B queries in one device round trip
     (the TPU throughput mode — vmap over queries, SURVEY §7.8)."""
     di = get_device_index(coll)
-    plans = [q if isinstance(q, QueryPlan) else compile_query(q, lang)
+    plans = [q if isinstance(q, QueryPlan) else _compile_cached(q, lang)
              for q in queries]
     g_stats.count("query", len(plans))
     with trace.timed_span("query.device_batch", queries=len(plans),

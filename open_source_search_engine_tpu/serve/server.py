@@ -277,10 +277,16 @@ class SearchHTTPServer:
         #: niceness gate: background requests yield to interactive
         from ..utils.nice import NicenessGate
         self.nice_gate = NicenessGate()
-        #: Msg17/Msg40Cache: rendered result pages, TTL'd (RdbCache
-        #: role via the general TtlCache)
-        from ..utils.ttlcache import TtlCache
-        self._result_cache = TtlCache(ttl_s=30.0, max_entries=2048)
+        #: Msg17/Msg40Cache: rendered result pages on the cache plane.
+        #: Generation-keyed per request via _result_gen — local index
+        #: version single-node, the shard/cluster generation vector on
+        #: the distributed planes (so a remote write invalidates the
+        #: SERP too, closing the stale-after-delete window the old
+        #: fixed-TTL cache had)
+        from ..cache import g_cacheplane
+        self._result_cache = g_cacheplane.register(
+            "server.results", ttl_s=30.0, max_entries=2048,
+            desc="rendered result pages (Msg17/Msg40Cache role)")
         #: per-user admin accounts (Users.cpp / users.txt)
         from ..utils.users import Users
         self.users = Users(base_dir)
@@ -419,7 +425,7 @@ class SearchHTTPServer:
                 return 401, json.dumps(
                     {"error": "bad or missing pwd"}), "application/json"
             return self._page_crawlbot(query)
-        if path in ("/inject", "/addurl"):
+        if path in ("/inject", "/addurl", "/delete"):
             # index-mutating endpoints are admin-gated once a master
             # password is set (the reference gates injection behind the
             # admin password, PageInject/Pages auth)
@@ -429,6 +435,8 @@ class SearchHTTPServer:
                     {"error": "bad or missing pwd"}), "application/json"
             if path == "/inject":
                 return self._page_inject(query, body)
+            if path == "/delete":
+                return self._page_delete(query)
             return self._page_addurl(query)
         if path.startswith("/admin") and not self._authorized(query):
             self.stats["auth_denied"] += 1
@@ -467,6 +475,8 @@ class SearchHTTPServer:
             return self._page_mem(query)
         if path == "/admin/transport":
             return self._page_transport(query)
+        if path == "/admin/cache":
+            return self._page_cache(query)
         if path == "/admin/traces":
             return self._page_traces(query)
         if path == "/admin/parms":
@@ -525,19 +535,60 @@ class SearchHTTPServer:
         rc_coll = self._coll_read(query)
         ttl = float(getattr(rc_coll.conf, "result_cache_ttl", 0)
                     if rc_coll is not None else 0)
-        ckey = None
+        swr = float(getattr(rc_coll.conf, "result_cache_swr", 0)
+                    if rc_coll is not None else 0)
+        ckey = gen = None
         # debug requests bypass the result cache both ways: a cached
         # body would echo a STALE trace id, and a debug body must not
         # poison the cache for ordinary requests
         if ttl > 0 and not debug:
-            ver = rc_coll.posdb.version if rc_coll is not None else 0
-            ckey = (cname, q, n, s, fmt, ver)
-            hit = self._result_cache.get(ckey)
-            if hit is not None:
+            gen = self._result_gen(rc_coll)
+            ckey = (cname, q, n, s, fmt)
+            hit, page = self._result_cache.lookup(ckey, gen=gen)
+            if hit:
                 self.stats["result_cache_hits"] = \
                     self.stats.get("result_cache_hits", 0) + 1
                 trace_mod.tag(result_cache="hit")
-                return hit
+                return page
+            if swr > 0:
+                # stale-while-revalidate for hot SERPs: serve the
+                # just-expired page and refresh in the background —
+                # never across a generation move (get_or_compute
+                # enforces that), so a write still invalidates
+                # instantly
+                page, status = self._result_cache.get_or_compute(
+                    ckey,
+                    lambda: self._render_search(query, q, n, s, fmt,
+                                                rc_coll, debug, tr),
+                    ttl_s=ttl, gen=gen, swr_s=swr)
+                if status in ("hit", "stale", "join"):
+                    self.stats["result_cache_hits"] = \
+                        self.stats.get("result_cache_hits", 0) + 1
+                    trace_mod.tag(result_cache=status)
+                return page
+        page = self._render_search(query, q, n, s, fmt, rc_coll,
+                                   debug, tr)
+        if ckey is not None:
+            self._result_cache.put(ckey, page, ttl_s=ttl, gen=gen)
+        return page
+
+    def _result_gen(self, rc_coll) -> tuple:
+        """The result cache's generation for one request: whatever
+        version vector a write to ANY backing index would move —
+        local posdb single-node, every shard's generation on the
+        distributed planes (the write-path invalidation contract)."""
+        if self.cluster is not None:
+            return ("cluster",) + self.cluster.gen_vector()
+        if self.sharded is not None:
+            return ("sharded",) + tuple(
+                coll.posdb.version
+                for row in self.sharded.grid for coll in row)
+        return ("flat",
+                rc_coll.posdb.version if rc_coll is not None else 0)
+
+    def _render_search(self, query: dict, q: str, n: int, s: int,
+                       fmt: str, rc_coll, debug: bool, tr
+                       ) -> tuple[int, str, str]:
         if self.cluster is not None:
             # conf is only consulted for PQR factors — never create a
             # local collection just to read it (rc_coll above already
@@ -568,9 +619,6 @@ class SearchHTTPServer:
         payload, ctype = render_results(
             res, fmt,
             trace_id=tr.trace_id if (debug and tr is not None) else None)
-        if ckey is not None:
-            self._result_cache.put(ckey, (200, payload, ctype),
-                                   ttl_s=ttl)
         return 200, payload, ctype
 
     def _page_get(self, query: dict) -> tuple[int, str, str]:
@@ -621,6 +669,30 @@ class SearchHTTPServer:
         return 200, json.dumps({"docId": ml.docid,
                                 "numKeys": len(ml.posdb_keys)}), \
             "application/json"
+
+    def _page_delete(self, query: dict) -> tuple[int, str, str]:
+        """Remove a url from the index (PageInject's delete form /
+        msgtype 0x07 with delete=1). The write bumps the backing
+        index's generation, which invalidates every dependent cache
+        entry — the inject→delete regression test drives this route."""
+        from ..build import docproc
+        url = query.get("u") or query.get("url")
+        if not url:
+            return 400, json.dumps({"error": "missing u"}), \
+                "application/json"
+        self.stats["deletes"] = self.stats.get("deletes", 0) + 1
+        if self.cluster is not None:
+            self.cluster.remove_document(url)
+            return 200, json.dumps({"deleted": url}), \
+                "application/json"
+        if self.sharded is not None:
+            ok = self.sharded.remove_document(url)
+        else:
+            ok = docproc.remove_document(self._coll(query), url)
+        if not ok:
+            return 404, json.dumps({"error": "not found"}), \
+                "application/json"
+        return 200, json.dumps({"deleted": url}), "application/json"
 
     def _page_addurl(self, query: dict) -> tuple[int, str, str]:
         """Queue a url for spidering (PageAddUrl.cpp)."""
@@ -710,7 +782,7 @@ class SearchHTTPServer:
         links = "".join(
             f'<li><a href="/admin/{p}{sfx}">{p}</a></li>'
             for p in ("stats", "hosts", "perf", "mem", "transport",
-                      "traces", "parms", "profiler", "graph"))
+                      "cache", "traces", "parms", "profiler", "graph"))
         rows = "".join(f"<tr><td>{k}</td><td>{v}</td></tr>"
                        for k, v in self.stats.items())
         colls = ", ".join(self.colldb.names())
@@ -764,18 +836,7 @@ class SearchHTTPServer:
         /admin/hosts and /admin/perf."""
         from ..parallel.transport import g_transport
         from ..utils.stats import g_stats
-        snap = g_stats.snapshot()
-        body = {
-            "counters": {k: v for k, v in sorted(
-                snap["counters"].items())
-                if k.startswith("transport.")},
-            "latencies": {k: v for k, v in sorted(
-                snap["latencies"].items())
-                if k.startswith("transport.")},
-            "gauges": {k: v for k, v in sorted(
-                snap.get("gauges", {}).items())
-                if k.startswith("transport.")},
-        }
+        body = g_stats.prefixed("transport.")
         tr = (self.cluster.transport if self.cluster is not None
               else g_transport)
         body["peers"] = tr.stats()
@@ -790,6 +851,55 @@ class SearchHTTPServer:
                     "addrs": self.cluster.conf.addresses[s],
                 } for s in range(hm.n_shards)}
         return 200, json.dumps(body), "application/json"
+
+    def _page_cache(self, query: dict) -> tuple[int, str, str]:
+        """The cache plane's admin page (the PageStats cache table
+        role): every registered cache with entries/bytes/hit rate/
+        generation, a per-cache flush link and a flush-all link.
+        ``?format=json`` returns the raw snapshot + the ``cache.*``
+        metric namespace; ``?flush=<name>`` / ``?flush=all`` flushes."""
+        from ..cache import g_cacheplane
+        from ..utils.stats import g_stats
+        flush = query.get("flush", "")
+        flushed = None
+        if flush:
+            flushed = g_cacheplane.flush(
+                None if flush == "all" else flush)
+        snap = g_cacheplane.snapshot()
+        if query.get("format") == "json":
+            body = {"caches": snap,
+                    "enabled": g_cacheplane.enabled,
+                    "metrics": g_stats.prefixed("cache.")}
+            if flushed is not None:
+                body["flushed_bytes"] = flushed
+            return 200, json.dumps(body), "application/json"
+        pwd = query.get("pwd", "")
+        sfx = f"&pwd={urllib.parse.quote(pwd)}" if pwd else ""
+        rows = "".join(
+            f"<tr><td>{nm}</td><td>{st['entries']}</td>"
+            f"<td>{st['bytes'] / (1 << 10):.1f}</td>"
+            f"<td>{st['hits']}</td><td>{st['misses']}</td>"
+            f"<td>{100.0 * st['hit_rate']:.1f}%</td>"
+            f"<td>{st['evictions']}</td><td>{st['stale_served']}</td>"
+            f"<td><code>{st['generation']}</code></td>"
+            f"<td>{'on' if st['enabled'] else 'off'}</td>"
+            f"<td><a href=\"/admin/cache?flush="
+            f"{urllib.parse.quote(nm)}{sfx}\">flush</a></td></tr>"
+            for nm, st in snap.items()) \
+            or "<tr><td colspan=11>no registered caches</td></tr>"
+        note = (f"<p>flushed {flushed} bytes</p>"
+                if flushed is not None else "")
+        return 200, (
+            "<html><head><title>gb cache</title></head><body>"
+            "<h1>cache plane</h1>"
+            f"<p>plane {'enabled' if g_cacheplane.enabled else 'DISABLED'}"
+            f" &middot; <a href=\"/admin/cache?flush=all{sfx}\">"
+            "flush all</a></p>" + note +
+            "<table border=1><tr><th>cache</th><th>entries</th>"
+            "<th>KB</th><th>hits</th><th>misses</th><th>hit rate</th>"
+            "<th>evict</th><th>stale</th><th>generation</th>"
+            f"<th>enabled</th><th></th></tr>{rows}</table>"
+            "</body></html>"), "text/html"
 
     #: waterfall bar palette — one color per host, assigned by hash so
     #: the same host colors the same across traces

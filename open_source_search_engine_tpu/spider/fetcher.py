@@ -52,17 +52,24 @@ class FetchResult:
 
 
 class RobotsCache:
-    """robots.txt fetch + parse cache (Msg13's robots cache)."""
+    """robots.txt fetch + parse cache (Msg13's robots cache), held on
+    the cache plane so /admin/cache sees it and memory pressure can
+    shed it (a re-fetch of robots.txt is cheap; an OOM is not)."""
+
+    ROBOTS_TTL_S = 3600.0  # re-fetch robots.txt hourly, Msg13-style
 
     def __init__(self, fetch_fn=None):
-        self._cache: dict[str, urllib.robotparser.RobotFileParser] = {}
+        from ..cache import g_cacheplane
+        self._cache = g_cacheplane.register(
+            "spider.robots", ttl_s=self.ROBOTS_TTL_S, max_entries=8192,
+            desc="parsed robots.txt per origin (Msg13 robots cache)")
         self._fetch_fn = fetch_fn  # injectable for tests
 
     def allowed(self, url: str) -> bool:
         parts = urllib.parse.urlsplit(url)
         origin = f"{parts.scheme}://{parts.netloc}"
-        rp = self._cache.get(origin)
-        if rp is None:
+        hit, rp = self._cache.lookup(origin)
+        if not hit:
             rp = urllib.robotparser.RobotFileParser()
             try:
                 raw = (self._fetch_fn(origin + "/robots.txt")
@@ -71,7 +78,7 @@ class RobotsCache:
                 rp.parse(raw.splitlines())
             except Exception:
                 rp.parse([])  # unreachable robots.txt = allow all
-            self._cache[origin] = rp
+            self._cache.put(origin, rp)
         return rp.can_fetch(USER_AGENT, url)
 
 
@@ -122,33 +129,20 @@ def _raw_get(url: str, timeout: float = 10.0) -> str:
 class ResponseCache:
     """TTL'd url → FetchResult cache (Msg13's response cache,
     ``Msg13.h:168`` — repeated fetches of one url within the TTL serve
-    from cache instead of re-hammering the site). Bounded LRU-ish."""
+    from cache instead of re-hammering the site), on the cache plane:
+    fetched bodies are the first thing memory pressure should drop."""
 
     def __init__(self, ttl_s: float = 3600.0, max_entries: int = 1024):
-        import threading
-        self.ttl_s = ttl_s
-        self.max_entries = max_entries
-        self._d: dict[str, tuple[float, FetchResult]] = {}
-        self._lock = threading.Lock()  # shared across fetch threads
+        from ..cache import g_cacheplane
+        self._cache = g_cacheplane.register(
+            "spider.responses", ttl_s=ttl_s, max_entries=max_entries,
+            desc="url → FetchResult bodies (Msg13 response cache)")
 
     def get(self, url: str) -> FetchResult | None:
-        import time
-        with self._lock:
-            hit = self._d.get(url)
-        if hit is None or hit[0] < time.monotonic():
-            return None
-        return hit[1]
+        return self._cache.get(url)
 
     def put(self, url: str, res: FetchResult) -> None:
-        import time
-        with self._lock:
-            if len(self._d) >= self.max_entries:
-                # drop the stalest half (cheap, rare)
-                for k in sorted(self._d,
-                                key=lambda k: self._d[k][0])[
-                        : self.max_entries // 2]:
-                    del self._d[k]
-            self._d[url] = (time.monotonic() + self.ttl_s, res)
+        self._cache.put(url, res)
 
 
 class Fetcher:

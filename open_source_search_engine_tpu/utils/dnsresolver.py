@@ -146,7 +146,14 @@ class DnsResolver:
                             [s for s in env.split(",") if s])
         self.iterative = iterative
         self.port = port
-        self._cache: dict[str, tuple[str | None, float]] = {}
+        #: host→ip answers on the cache plane (the Msg13 DNS-cache
+        #: slice of RdbCache); per-entry TTL from the A record,
+        #: negative answers cached briefly as None — hence lookup()'s
+        #: (hit, value) form rather than get()
+        from ..cache import g_cacheplane
+        self._cache = g_cacheplane.register(
+            "dns", ttl_s=TTL_MAX_S, max_entries=200_000,
+            desc="A-record answers incl. negatives (Msg13 DNS cache)")
         self._inflight: dict[str, threading.Event] = {}
         self._lock = threading.Lock()
         self._rr = 0  # server round-robin cursor
@@ -154,21 +161,12 @@ class DnsResolver:
     # -- cache ----------------------------------------------------------
 
     def _cache_get(self, host: str) -> tuple[bool, str | None]:
-        with self._lock:
-            hit = self._cache.get(host)
-            if hit is not None and hit[1] > time.monotonic():
-                return True, hit[0]
-        return False, None
+        return self._cache.lookup(host)
 
     def _cache_put(self, host: str, ip: str | None, ttl: float) -> None:
         ttl = min(max(ttl, TTL_MIN_S), TTL_MAX_S) if ip is not None \
             else NEGATIVE_TTL_S
-        with self._lock:
-            self._cache[host] = (ip, time.monotonic() + ttl)
-            if len(self._cache) > 200_000:  # bound the cache
-                now = time.monotonic()
-                self._cache = {h: v for h, v in self._cache.items()
-                               if v[1] > now}
+        self._cache.put(host, ip, ttl_s=ttl)
 
     # -- wire -----------------------------------------------------------
 

@@ -46,7 +46,7 @@ DEFAULT_LIMIT = 4 << 30
 
 #: the per-subsystem labels the core planes report under (free-form
 #: strings are accepted; these are the wired ones)
-LABELS = ("memtable", "merge", "pack", "docproc")
+LABELS = ("memtable", "merge", "pack", "docproc", "cache")
 
 
 class MemBudget:

@@ -68,6 +68,7 @@ PARMS: list[Parm] = [
     _p("alert_cmd", "alertcmd", str, "", GLOBAL, "command run on host death/recovery with OSSE_ALERT_* env (PingServer.h:77 email/SMS role); empty = log only", broadcast=False),
     _p("trace_sample", "tsample", int, 64, GLOBAL, "head-sample 1 in N query traces (utils.trace, Dapper-style); 1 = every query, 0 = tracing off"),
     _p("slow_query_ms", "slowms", float, 1000.0, GLOBAL, "queries slower than this keep their trace regardless of sampling and land in slowlog.jsonl"),
+    _p("shard_cache_ttl", "shcttl", float, 30.0, GLOBAL, "seconds a shard node caches /rpc/search replies (termlist-cache role, RdbCache); generation-invalidated on writes, 0 disables"),
     # --- per-collection (coll.conf / CollectionRec) ---
     _p("docs_wanted", "n", int, 10, COLL, "results per page (SearchInput 'n')"),
     _p("site_cluster", "sc", bool, True, COLL, "max-2-per-site clustering (Msg51/Clusterdb)"),
@@ -81,6 +82,7 @@ PARMS: list[Parm] = [
     _p("summary_excerpts", "ns", int, 3, COLL, "summary excerpt count (Summary.h)"),
     _p("pqr_enabled", "pqr", bool, True, COLL, "post-query rerank pass (PostQueryRerank.cpp)"),
     _p("result_cache_ttl", "rcttl", float, 10.0, COLL, "seconds to cache rendered result pages (Msg17/Msg40Cache); 0 disables"),
+    _p("result_cache_swr", "rcswr", float, 0.0, COLL, "stale-while-revalidate window after result_cache_ttl expires: serve the stale page and refresh in the background (same generation only); 0 disables"),
     _p("pqr_lang_demote", "pqrlang", float, 0.8, COLL, "foreign-language demotion factor (m_pqr_demFactForeignLanguage)"),
     _p("pqr_site_demote", "pqrsite", float, 0.85, COLL, "per-extra-result same-domain demotion (PQR diversity role)"),
     _p("pqr_depth_demote", "pqrdepth", float, 0.97, COLL, "url path-depth demotion (prefer canonical pages)"),

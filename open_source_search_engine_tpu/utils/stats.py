@@ -115,6 +115,20 @@ class Stats:
                 "gauges": dict(self.gauges),
             }
 
+    def prefixed(self, prefix: str) -> dict:
+        """Snapshot filtered to one subsystem's namespace — the admin
+        pages' per-plane view (``/admin/cache`` wants ``cache.*`` only)."""
+        with self._lock:
+            return {
+                "counters": {k: v for k, v in self.counters.items()
+                             if k.startswith(prefix)},
+                "latencies": {k: v.to_dict()
+                              for k, v in self.latencies.items()
+                              if k.startswith(prefix)},
+                "gauges": {k: v for k, v in self.gauges.items()
+                           if k.startswith(prefix)},
+            }
+
     def series(self, last_s: float = 600.0) -> list:
         cutoff = time.time() - last_s
         with self._lock:

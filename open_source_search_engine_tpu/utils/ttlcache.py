@@ -46,10 +46,17 @@ class TtlCache:
         now = time.monotonic()
         with self._lock:
             if len(self._d) >= self.max_entries:
-                for k in sorted(self._d,
-                                key=lambda k: self._d[k][0])[
-                        : self.max_entries // 2]:
+                # dead-generation and already-expired entries are free
+                # wins — drop them before sacrificing live ones
+                dead = [k for k, (exp, ver, _) in self._d.items()
+                        if exp < now or ver != self._version]
+                for k in dead:
                     del self._d[k]
+                if len(self._d) >= self.max_entries:
+                    for k in sorted(self._d,
+                                    key=lambda k: self._d[k][0])[
+                            : self.max_entries // 2]:
+                        del self._d[k]
             self._d[key] = (now + (ttl_s if ttl_s is not None
                                    else self.ttl_s),
                             self._version, value)
@@ -59,6 +66,12 @@ class TtlCache:
             self._d.clear()
 
     def stats(self) -> dict:
+        now = time.monotonic()
         with self._lock:
-            return {"entries": len(self._d), "hits": self.hits,
-                    "misses": self.misses, "version": self._version}
+            # "live" counts only what a get() could still return —
+            # tombstones from bump_version() must not inflate gauges
+            live = sum(1 for exp, ver, _ in self._d.values()
+                       if exp >= now and ver == self._version)
+            return {"entries": len(self._d), "live": live,
+                    "hits": self.hits, "misses": self.misses,
+                    "version": self._version}

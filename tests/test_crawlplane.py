@@ -100,8 +100,9 @@ class TestDnsResolver:
             assert r.resolve("example.test") == "10.1.2.3"
             assert r.resolve("example.test") == "10.1.2.3"
             assert len(srv.queries) == 1  # second hit came from cache
-            # per-record TTL honored (not a fixed module TTL)
-            _, exp = r._cache["example.test"]
+            # per-record TTL honored (not a fixed module TTL); entry
+            # layout on the cache plane is (expiry, gen, cost, value)
+            exp = r._cache._d["example.test"][0]
             assert 200 < exp - time.monotonic() <= 300
         finally:
             srv.stop()

@@ -35,6 +35,18 @@ class TestTtlCache:
         c.bump_version()
         assert c.get("k") is None
 
+    def test_put_sheds_dead_entries_before_live(self):
+        c = TtlCache(ttl_s=60, max_entries=4)
+        for k in ("a", "b", "c"):
+            c.put(k, k)
+        c.bump_version()  # all three are now dead-generation
+        c.put("d", "d")
+        c.put("e", "e")   # at cap: the dead entries go, not the live
+        assert c.get("d") == "d" and c.get("e") == "e"
+        st = c.stats()
+        assert st["entries"] == 2
+        assert st["live"] == 2
+
 
 class TestResultCache:
     def test_search_page_cached_and_invalidated(self, tmp_path):
@@ -173,8 +185,10 @@ class TestAlerting:
             time.sleep(0.1)
         lines = marker.read_text().splitlines()
         assert len(lines) == 2
-        assert lines[0].startswith("dead ")
-        assert lines[1].startswith("recovered ")
+        # the two alert_cmd subprocesses are fire-and-forget (Popen,
+        # no wait) — their appends may land in either order
+        assert sorted(ln.split()[0] for ln in lines) == \
+            ["dead", "recovered"]
 
 
 class TestTransportLint:
@@ -192,3 +206,23 @@ class TestTransportLint:
             text = py.read_text(encoding="utf-8")
             assert "urlopen" not in text, (
                 f"{py.name} bypasses the pooled transport")
+
+
+class TestCachePlaneLint:
+    def test_no_ad_hoc_ttlcache_outside_the_plane(self):
+        """Every cache belongs on the cache plane — registered,
+        membudget-charged, generation-invalidated, and visible on
+        ``/admin/cache``. A raw ``TtlCache(`` construction anywhere
+        else is an unaccounted cache the pressure handler can't shed
+        and the admin page can't see."""
+        from pathlib import Path
+
+        import open_source_search_engine_tpu as pkg
+        root = Path(pkg.__file__).parent
+        for py in root.rglob("*.py"):
+            rel = py.relative_to(root).as_posix()
+            if rel.startswith("cache/") or rel == "utils/ttlcache.py":
+                continue
+            text = py.read_text(encoding="utf-8")
+            assert "TtlCache(" not in text, (
+                f"{rel} constructs an off-plane TtlCache")
