@@ -94,14 +94,18 @@ def _estimate_cost(value: Any, _depth: int = 0) -> int:
 
 class _Flight:
     """One in-flight compute (the single-flight unit): the leader fills
-    ``value``/``err`` and sets the event; followers wait on it."""
+    ``value``/``err`` and sets the event; followers wait on it. The
+    flight remembers the generation its leader started under — a caller
+    whose generation differs must NOT join: the leader's result is
+    pre-write from that caller's point of view."""
 
-    __slots__ = ("event", "value", "err")
+    __slots__ = ("event", "value", "err", "gen")
 
-    def __init__(self):
+    def __init__(self, gen: Any = None):
         self.event = threading.Event()
         self.value: Any = None
         self.err: BaseException | None = None
+        self.gen = gen
 
 
 class GenCache:
@@ -250,9 +254,15 @@ class GenCache:
         Single-flight: concurrent identical misses elect one leader;
         the rest block on its result. A leader failure propagates to
         every waiter of that flight (retrying N times in lockstep is
-        the stampede this exists to prevent). Stale serves never cross
-        a generation move — a write invalidates instantly; only TTL
-        expiry is softened.
+        the stampede this exists to prevent). A caller only joins a
+        flight whose leader started under the SAME generation — if a
+        write moved the generation since the leader began, the leader's
+        result is pre-write and the caller computes its own. Stale
+        serves never cross a generation move — a write invalidates
+        instantly; only TTL expiry is softened. The generation is
+        captured once at entry and stamps the stored entry, so a write
+        landing during the compute yields a dead entry (a later miss),
+        never a pre-write value passing as fresh.
         """
         if not self.enabled:
             return compute(), "miss"
@@ -271,16 +281,24 @@ class GenCache:
                     self.stale_served += 1
                     g_stats.count(f"cache.{self.name}.hit")
                     g_stats.count(f"cache.{self.name}.stale")
-                    self._spawn_refresh_locked(key, compute, ttl_s, gen)
+                    self._spawn_refresh_locked(key, compute, ttl_s, g)
                     return e[3], "stale"
             self.misses += 1
             g_stats.count(f"cache.{self.name}.miss")
             fl = self._inflight.get(key)
-            if fl is None:
-                fl = self._inflight[key] = _Flight()
-                leader = True
-            else:
+            if fl is not None and fl.gen == g:
                 leader = False
+            else:
+                # no flight, or the in-flight leader started under a
+                # different generation (a write landed since it began):
+                # its value is pre-write for us, so compute our own
+                # rather than join; only register in the flight map
+                # when the slot is actually free
+                registered = fl is None
+                fl = _Flight(g)
+                if registered:
+                    self._inflight[key] = fl
+                leader = True
             g_stats.gauge(f"cache.{self.name}.inflight",
                           len(self._inflight))
         if not leader:
@@ -293,7 +311,10 @@ class GenCache:
             with trace_mod.timed_span(f"cache.{self.name}.fill"):
                 value = compute()
             fl.value = value
-            self.put(key, value, ttl_s=ttl_s, gen=gen)
+            # stamp with the generation captured at ENTRY, not re-read
+            # now: a write landing during the compute must leave this
+            # entry dead (a miss), never stale-served-fresh
+            self.put(key, value, ttl_s=ttl_s, gen=g)
         except BaseException as exc:
             fl.err = exc
             raise
@@ -301,32 +322,37 @@ class GenCache:
             # value/err are published BEFORE the event: a follower must
             # never wake to an unfilled flight
             with self._lock:
-                self._inflight.pop(key, None)
+                if self._inflight.get(key) is fl:
+                    del self._inflight[key]
                 g_stats.gauge(f"cache.{self.name}.inflight",
                               len(self._inflight))
             fl.event.set()
         return value, "miss"
 
-    def _spawn_refresh_locked(self, key, compute, ttl_s, gen) -> None:
+    def _spawn_refresh_locked(self, key, compute, ttl_s, g) -> None:
         """Background SWR refresh, deduped through the in-flight map
-        (caller holds the lock)."""
+        (caller holds the lock). ``g`` is the resolved generation the
+        stale serve happened under — the refreshed entry is stamped
+        with it, so a write landing mid-refresh leaves a dead entry
+        rather than a stale one passing as fresh."""
         if key in self._inflight:
             return  # a refresh (or a concurrent miss) already runs
-        fl = self._inflight[key] = _Flight()
+        fl = self._inflight[key] = _Flight(g)
 
         def _refresh():
             try:
                 with trace_mod.timed_span(f"cache.{self.name}.refresh"):
                     value = compute()
                 fl.value = value
-                self.put(key, value, ttl_s=ttl_s, gen=gen)
+                self.put(key, value, ttl_s=ttl_s, gen=g)
             except BaseException as exc:  # noqa: BLE001 — background
                 fl.err = exc
                 log.warning("swr refresh of %s[%r] failed: %s",
                             self.name, key, exc)
             finally:
                 with self._lock:
-                    self._inflight.pop(key, None)
+                    if self._inflight.get(key) is fl:
+                        del self._inflight[key]
                 fl.event.set()
 
         threading.Thread(target=_refresh, daemon=True,

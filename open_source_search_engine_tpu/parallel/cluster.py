@@ -1179,8 +1179,13 @@ class ClusterClient:
         keyed on the full request shape, generation = the shard-gen
         vector, single-flight so a stampede of one hot query runs the
         scatter once."""
-        key = (q, topk, lang, with_snippets, site_cluster, offset,
-               id(conf) if conf is not None else 0)
+        # conf enters the ranking only through the PQR factors
+        # (engine.apply_pqr), so key on those values — never id(conf):
+        # CPython reuses object ids, and equal confs should share
+        pqr = None if conf is None else (
+            bool(conf.pqr_enabled), float(conf.pqr_lang_demote),
+            float(conf.pqr_site_demote), float(conf.pqr_depth_demote))
+        key = (q, topk, lang, with_snippets, site_cluster, offset, pqr)
         out, _ = self._result_cache.get_or_compute(
             key, lambda: self._search_uncached(
                 q, topk=topk, lang=lang, with_snippets=with_snippets,

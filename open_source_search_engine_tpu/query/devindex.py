@@ -1340,12 +1340,16 @@ class DeviceIndex:
                      and sort_base_of is None)
         if cacheable:
             plans = []
+            # generation captured BEFORE the plan builds: a write
+            # landing mid-build moves it, so the entry we store is
+            # already dead instead of a pre-write plan served as fresh
+            pgen = self._plan_cache.current_gen()
             for qp in qplans:
                 ck = (qp.raw, qp.lang)
-                hit, p = self._plan_cache.lookup(ck)
+                hit, p = self._plan_cache.lookup(ck, gen=pgen)
                 if not hit:
                     p = self.plan(qp)
-                    self._plan_cache.put(ck, p)
+                    self._plan_cache.put(ck, p, gen=pgen)
                 plans.append(p)
         else:
             plans = [self.plan(qp, df_of=df_of, total_docs=total_docs,

@@ -22,6 +22,7 @@ from open_source_search_engine_tpu.parallel import cluster as cl
 from open_source_search_engine_tpu.serve.server import SearchHTTPServer
 from open_source_search_engine_tpu.utils import ghash
 from open_source_search_engine_tpu.utils.membudget import g_membudget
+from open_source_search_engine_tpu.utils.parms import CollectionConf
 
 
 def _doc(i, words="cluster shared words"):
@@ -163,6 +164,50 @@ class TestGenCache:
         assert ("leader", "boom") in errors
         assert len(errors) == 2
 
+    def test_compute_racing_a_write_stores_a_dead_entry(self):
+        # the generation is captured at ENTRY: a write landing during
+        # the compute must leave the stored entry dead (a later miss),
+        # never stamp the pre-write result with the post-write gen
+        gen = [1]
+        c = GenCache("t.race", ttl_s=60, gen_fn=lambda: gen[0])
+
+        def compute():
+            gen[0] = 2  # a write lands mid-compute
+            return "pre-write"
+
+        v, status = c.get_or_compute("k", compute)
+        assert (v, status) == ("pre-write", "miss")
+        # the entry carries the entry-time gen (1) → post-write lookups
+        # (gen 2) miss instead of serving the pre-write value as fresh
+        assert c.lookup("k") == (False, None)
+
+    def test_no_join_across_a_generation_move(self):
+        # a flight started under gen 1 must not hand its (pre-write)
+        # result to a caller arriving after the write moved gen to 2
+        gen = [1]
+        c = GenCache("t.sfgen", ttl_s=60, gen_fn=lambda: gen[0])
+        entered = threading.Event()
+        release = threading.Event()
+        out = {}
+
+        def slow_pre_write():
+            entered.set()
+            release.wait(5)
+            return "pre-write"
+
+        t = threading.Thread(target=lambda: out.update(
+            leader=c.get_or_compute("k", slow_pre_write)))
+        t.start()
+        assert entered.wait(5)
+        gen[0] = 2  # the write lands while the leader computes
+        v, status = c.get_or_compute("k", lambda: "post-write")
+        assert (v, status) == ("post-write", "miss")  # NOT a join
+        release.set()
+        t.join(timeout=10)
+        assert out["leader"] == ("pre-write", "miss")
+        # the leader's late put is stamped gen 1 → dead at gen 2
+        assert c.lookup("k") == (False, None)
+
     def test_swr_serves_stale_then_refreshes(self):
         c = GenCache("t.swr", ttl_s=0.05)
         versions = iter(["v1", "v2"])
@@ -189,6 +234,29 @@ class TestGenCache:
         v, status = c.get_or_compute("k", lambda: "new", gen=2,
                                      swr_s=10.0)
         assert (v, status) == ("new", "miss")
+
+    def test_swr_refresh_racing_a_write_stores_a_dead_entry(self):
+        # the background SWR refresh stamps with the gen the stale
+        # serve happened under — a write landing mid-refresh must
+        # leave a dead entry, not a pre-write value passing as fresh
+        gen = [1]
+        c = GenCache("t.swrrace", ttl_s=0.05, gen_fn=lambda: gen[0])
+        c.put("k", "old")
+        time.sleep(0.08)  # past TTL, inside the swr window
+
+        def refresh_with_write():
+            gen[0] = 2  # a write lands during the refresh
+            return "pre-write"
+
+        v, status = c.get_or_compute("k", refresh_with_write,
+                                     swr_s=10.0)
+        assert (v, status) == ("old", "stale")
+        for _ in range(100):  # wait out the background refresh
+            with c._lock:
+                if "k" not in c._inflight:
+                    break
+            time.sleep(0.02)
+        assert c.lookup("k") == (False, None)
 
     def test_disabled_cache_is_transparent(self):
         c = GenCache("t.off", ttl_s=60)
@@ -334,6 +402,31 @@ class TestClusterGenerations:
             a.stop()
             b.stop()
 
+    def test_result_cache_keys_on_conf_values_not_identity(self, tmp_path):
+        """The SERP key must use the conf's PQR factor VALUES, never
+        id(conf): CPython reuses freed ids (a new conf could alias a
+        dead one's entries), and equal-but-distinct confs should
+        share."""
+        a, b, client = self._cluster(tmp_path)
+        try:
+            warm = CollectionConf()
+            # first scatter settles the node generations; second fills
+            # a live entry under them
+            client.search("token0", topk=5, conf=warm)
+            client.search("token0", topk=5, conf=warm)
+            h0 = client._result_cache.hits
+            # a DIFFERENT conf object with equal factors shares it
+            client.search("token0", topk=5, conf=CollectionConf())
+            assert client._result_cache.hits == h0 + 1
+            # changed PQR factors → a distinct entry, not an alias
+            client.search("token0", topk=5,
+                          conf=CollectionConf(pqr_enabled=False))
+            assert client._result_cache.hits == h0 + 1
+        finally:
+            client.close()
+            a.stop()
+            b.stop()
+
     def test_inject_query_delete_query_no_stale_result(self, tmp_path):
         """The acceptance regression: a deleted doc must never ride a
         cached SERP — the generation bump is observed cluster-wide in
@@ -345,6 +438,12 @@ class TestClusterGenerations:
             client.index_document(
                 u, _doc(7, words="zebra quagga savanna"))
             _drain(client)
+            # the first scatter on a cold client folds the node
+            # generations in via X-OSSE-Gen, so its own entry — stamped
+            # with the ENTRY-time gen, by design — is already dead
+            # (correctness over hit rate); it settles the gens for the
+            # searches under test
+            client.search("zebra", topk=5)
             res1 = client.search("zebra", topk=5)
             assert res1.total_matches == 1
             assert res1.results[0].url == u
