@@ -49,7 +49,8 @@ from typing import Any, Callable, Hashable
 
 import numpy as np
 
-from ..utils import trace as trace_mod
+from ..utils import threads, trace as trace_mod
+from ..utils.lockcheck import make_lock
 from ..utils.log import get_logger
 from ..utils.membudget import g_membudget
 from ..utils.stats import g_stats
@@ -135,7 +136,7 @@ class GenCache:
         self.enabled = True
         self._d: dict[Hashable, tuple[float, Any, int, Any]] = {}
         self._bytes = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("cache.gencache")
         self._inflight: dict[Hashable, _Flight] = {}
         self.hits = 0
         self.misses = 0
@@ -355,8 +356,7 @@ class GenCache:
                         del self._inflight[key]
                 fl.event.set()
 
-        threading.Thread(target=_refresh, daemon=True,
-                         name=f"swr-{self.name}").start()
+        threads.spawn(f"swr-{self.name}", _refresh)
 
     # --- introspection ----------------------------------------------------
 
@@ -366,8 +366,9 @@ class GenCache:
             gen = None
             try:
                 gen = self.current_gen()
-            except Exception:  # noqa: BLE001 — gen_fn owner half-dead
-                pass
+            except Exception as exc:  # noqa: BLE001 — owner half-dead
+                g_stats.count(f"cache.{self.name}.gen_error")
+                log.debug("gen_fn of %s failed: %s", self.name, exc)
             return {
                 "entries": len(self._d),
                 "bytes": self._bytes,
@@ -387,7 +388,7 @@ class GenCache:
     def __del__(self):  # noqa: D105 — drop the membudget gauge with us
         try:
             g_membudget.set_gauge(MEM_LABEL, self.name, 0)
-        except Exception:  # noqa: BLE001 — interpreter teardown
+        except Exception:  # osselint: ignore[silent-except] — teardown
             pass
 
 
@@ -397,7 +398,7 @@ class CachePlane:
 
     def __init__(self):
         import weakref
-        self._lock = threading.Lock()
+        self._lock = make_lock("cache.plane")
         self._caches: "weakref.WeakValueDictionary[str, GenCache]" = \
             weakref.WeakValueDictionary()
         #: plane-wide kill switch, seeded from OSSE_CACHE (0 = off)

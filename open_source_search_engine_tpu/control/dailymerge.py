@@ -13,6 +13,7 @@ import threading
 import time
 from datetime import datetime
 
+from ..utils import threads
 from ..utils.log import get_logger
 
 log = get_logger("dailymerge")
@@ -85,9 +86,7 @@ class DailyMerge:
         def loop():
             while not self._stop.wait(self._interval):
                 self.tick()
-        self._thread = threading.Thread(target=loop, daemon=True,
-                                        name="dailymerge")
-        self._thread.start()
+        self._thread = threads.spawn("dailymerge", loop)
 
     def stop(self) -> None:
         self._stop.set()

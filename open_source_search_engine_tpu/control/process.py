@@ -15,6 +15,7 @@ import signal
 import threading
 import time
 
+from ..utils import threads
 from ..utils.log import get_logger
 
 log = get_logger("process")
@@ -64,9 +65,7 @@ class Process:
                 log.info("autosave")
                 self.save_all()
 
-        self._thread = threading.Thread(target=loop, daemon=True,
-                                        name="autosave")
-        self._thread.start()
+        self._thread = threads.spawn("autosave", loop)
 
     # --- orderly shutdown (Process::shutdown) ---
 
@@ -128,9 +127,7 @@ class Heartbeat:
             while not self._stop.wait(self.interval_s):
                 self.check_once()
 
-        self._thread = threading.Thread(target=loop, daemon=True,
-                                        name="heartbeat")
-        self._thread.start()
+        self._thread = threads.spawn("heartbeat", loop)
 
     def stop(self) -> None:
         self._stop.set()

@@ -15,8 +15,11 @@ from __future__ import annotations
 import json
 from pathlib import Path
 
+from ..utils.log import get_logger
 from ..utils.parms import CollectionConf
 from . import clusterdb, posdb, rdblite, titledb
+
+log = get_logger("collection")
 
 
 class TermlistCache:
@@ -75,8 +78,9 @@ class Collection:
         if conf is None and self._conf_path.exists():
             try:
                 self.conf.load(self._conf_path)
-            except Exception:  # noqa: BLE001 — torn write; defaults win
-                pass
+            except Exception as exc:  # noqa: BLE001 — defaults win
+                log.warning("%s: coll.conf unreadable (%s) — using "
+                            "defaults", name, exc)
         self.posdb = rdblite.Rdb("posdb", self.dir, posdb.KEY_DTYPE)
         self.titledb = rdblite.Rdb("titledb", self.dir, titledb.KEY_DTYPE,
                                    has_data=True)

@@ -40,10 +40,27 @@ from pathlib import Path
 
 import numpy as np
 
+from ..utils.lockcheck import make_rlock
 from ..utils.log import get_logger
 from ..utils.membudget import g_membudget
 
 log = get_logger("rdb")
+
+
+def _locked(fn):
+    """Serialize a mutating Rdb method on the instance write lock.
+
+    The reference serializes tree writes on the main event loop; here
+    writers can be real threads (DailyMerge's forced sweep vs. the
+    indexing path), so every mutation takes the per-Rdb RLock —
+    reentrant because add→dump→attempt_merge nest."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._wlock:
+            return fn(self, *args, **kwargs)
+    return wrapper
 
 #: keys per RdbMap "page" — the reference maps one key per 16KB disk page
 #: (``RdbMap.h:64``); ours indexes every PAGE_KEYS keys of a run.
@@ -512,6 +529,9 @@ class Rdb:
         self.has_data = has_data
         self.max_memtable_bytes = max_memtable_bytes
         self.max_runs = max_runs
+        #: write lock: mutations may come from the indexing thread AND
+        #: the DailyMerge/autosave threads concurrently
+        self._wlock = make_rlock(f"rdb.{name}")
         self.mem = MemTable(key_dtype, has_data)
         self.runs: list[Run] = []
         #: names of runs quarantined at load (corrupt — healed by
@@ -553,6 +573,7 @@ class Rdb:
 
     # --- writes ---
 
+    @_locked
     def add(self, keys: np.ndarray, blobs: list[bytes] | None = None) -> None:
         """Add records; auto-dump when the memtable exceeds budget
         (reference dumps at 90% full, ``Rdb.cpp:1172``). The write
@@ -569,6 +590,7 @@ class Rdb:
                 and not g_membudget.would_fit(0)):
             self.dump()
 
+    @_locked
     def delete(self, keys: np.ndarray) -> None:
         """Add tombstones for these keys (delbit cleared)."""
         neg = strip_delbit(np.atleast_1d(keys).astype(self.key_dtype, copy=False))
@@ -578,6 +600,7 @@ class Rdb:
         self.version += 1
         g_membudget.set_gauge("memtable", str(self.dir), self.mem.nbytes)
 
+    @_locked
     def wipe(self) -> None:
         """Drop ALL state (memtable + runs) — the Repair rebuild's
         'destroy the secondary instance' step (Repair.h:20)."""
@@ -592,6 +615,7 @@ class Rdb:
         self._journal_truncate()
         self.version += 1
 
+    @_locked
     def dump(self) -> Run | None:
         """Memtable → new immutable run (RdbDump)."""
         batch = self.mem.batch()
@@ -614,6 +638,7 @@ class Rdb:
             self.attempt_merge()
         return run
 
+    @_locked
     def attempt_merge(self, force: bool = False) -> None:
         """Merge runs down to bound file count (RdbBase::attemptMerge,
         ``RdbBase.cpp:1400``).
@@ -688,6 +713,7 @@ class Rdb:
         finally:
             g_membudget.release("merge", est)
 
+    @_locked
     def scrub(self) -> list[str]:
         """Re-verify every loaded run NOW; quarantine failures (the
         admin-triggered integrity sweep — load-time verification only
@@ -713,6 +739,7 @@ class Rdb:
             self.version += 1
         return bad
 
+    @_locked
     def replace_with(self, batch: RecordBatch) -> None:
         """Wipe and reload from one merged batch — the twin-patch
         receive side (Msg5 error correction's 'get the list from the
@@ -742,6 +769,7 @@ class Rdb:
 
     # --- checkpoint (Process::saveRdbTrees equivalent) ---
 
+    @_locked
     def save(self) -> None:
         """Persist the memtable so a restart is lossless (``-saved.dat``).
 

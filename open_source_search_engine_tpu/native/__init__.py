@@ -11,6 +11,7 @@ every caller has a vectorized-numpy fallback, so the framework works
 from __future__ import annotations
 
 import ctypes
+import os
 import subprocess
 import threading
 from pathlib import Path
@@ -21,19 +22,32 @@ from ..utils.log import get_logger
 
 log = get_logger("native")
 
+#: OSSE_NATIVE_SAN=1 → build/load ASan+UBSan-instrumented natives
+#: instead of the optimized ones. Separate ``.san.so`` artifact names so
+#: the two modes never clobber each other's build cache. The sanitizer
+#: runtimes must be preloaded into the (uninstrumented) Python process —
+#: ``tools/native_san_check.py`` handles the LD_PRELOAD dance.
+SANITIZE = os.environ.get("OSSE_NATIVE_SAN") == "1"
+_SAN_FLAGS = ["-fsanitize=address,undefined", "-fno-omit-frame-pointer",
+              "-g", "-O1"]
+
 _DIR = Path(__file__).parent
 _SRC = _DIR / "rdbcore.cpp"
-_SO = _DIR / "librdbcore.so"
+_SO = _DIR / ("librdbcore.san.so" if SANITIZE else "librdbcore.so")
 _lock = threading.Lock()
 _lib = None
 _tried = False
 
 
+def _gxx_cmd(opt: str, src: Path, out: Path) -> list[str]:
+    flags = _SAN_FLAGS if SANITIZE else [opt]
+    return ["g++", *flags, "-shared", "-fPIC", str(src), "-o", str(out)]
+
+
 def _build() -> bool:
     try:
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", str(_SRC), "-o", str(_SO)],
-            check=True, capture_output=True, timeout=120)
+        subprocess.run(_gxx_cmd("-O3", _SRC, _SO),
+                       check=True, capture_output=True, timeout=120)
         return True
     except Exception as e:  # noqa: BLE001 — fall back to numpy
         log.warning("native build failed (numpy fallback in use): %s", e)
@@ -109,7 +123,7 @@ def searchsorted(sorted_keys: np.ndarray, probe: np.ndarray,
 # --- doccore: native HTML tokenize + term hash + rank columns ----------
 
 _DOC_SRC = _DIR / "doccore.cpp"
-_DOC_SO = _DIR / "libdoccore.so"
+_DOC_SO = _DIR / ("libdoccore.san.so" if SANITIZE else "libdoccore.so")
 _doc_lib = None
 _doc_tried = False
 
@@ -154,10 +168,8 @@ class _OsseDoc(ctypes.Structure):
 
 def _build_doccore() -> bool:
     try:
-        subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", str(_DOC_SRC), "-o",
-             str(_DOC_SO)],
-            check=True, capture_output=True, timeout=180)
+        subprocess.run(_gxx_cmd("-O2", _DOC_SRC, _DOC_SO),
+                       check=True, capture_output=True, timeout=180)
         return True
     except Exception as e:  # noqa: BLE001 — fall back to Python
         log.warning("doccore build failed (python tokenizer in use): %s",

@@ -50,7 +50,9 @@ import numpy as np
 from ..cache import g_cacheplane
 from ..index.collection import Collection
 from ..utils import ghash
+from ..utils import threads
 from ..utils import trace as trace_mod
+from ..utils.lockcheck import make_lock, make_rlock
 from ..utils.log import get_logger
 from ..utils.stats import g_stats
 from . import transport as transport_mod
@@ -139,12 +141,12 @@ class ShardNodeServer:
         self.port = port
         self.use_device = use_device
         self._httpd: ThreadingHTTPServer | None = None
-        self._lock = threading.RLock()  # single-writer core
+        self._lock = make_rlock("cluster.node_writer")  # single-writer core
         #: TCP connections accepted since start — with a pooled client
         #: this stays ~1 per peer; it climbing with request count means
         #: keep-alive broke somewhere
         self.accepts = 0
-        self._accept_lock = threading.Lock()
+        self._accept_lock = make_lock("cluster.accepts")
         #: live accepted sockets: stop() must sever them, or a handler
         #: thread parked on a keep-alive connection outlives the
         #: "stopped" server and keeps answering for a dead node
@@ -576,8 +578,8 @@ class ShardNodeServer:
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
-        threading.Thread(target=self._httpd.serve_forever,
-                         daemon=True).start()
+        threads.spawn(f"shard-node-{self.port}",
+                      self._httpd.serve_forever)
         log.info("shard node on %s:%d (%d docs)", self.host, self.port,
                  self.coll.num_docs)
 
@@ -663,7 +665,7 @@ class _HostQueue:
 
     def __init__(self):
         self.items: list[_Pending] = []
-        self.lock = threading.Lock()
+        self.lock = make_lock("cluster.hostqueue")
         self.in_flight = False
 
     def __len__(self) -> int:
@@ -697,10 +699,8 @@ class _ShardSearchBatcher:
         with self._cv:
             self._queue.append(((topk, lang), q, holder, parent_span))
             if self._thread is None or not self._thread.is_alive():
-                self._thread = threading.Thread(
-                    target=self._run, daemon=True,
-                    name=f"shard{self.shard}-qbatch")
-                self._thread.start()
+                self._thread = threads.spawn(
+                    f"shard{self.shard}-qbatch", self._run)
             self._cv.notify_all()
         deadline = time.monotonic() + timeout + 5.0
         with self._cv:
@@ -790,7 +790,7 @@ class ClusterClient:
         # window. The node half folds in X-OSSE-Gen reply headers: a
         # write from ANOTHER client shows up at our next read of any
         # kind and invalidates our entries too.
-        self._gen_lock = threading.Lock()
+        self._gen_lock = make_lock("cluster.gen")
         self._gen_local = [0] * conf.n_shards
         self._gen_node = [0] * conf.n_shards
         self._addr_shard = {conf.addresses[s][r]: s
@@ -832,15 +832,12 @@ class ClusterClient:
         #: reads must not starve write delivery of workers
         self._read_pool = ThreadPoolExecutor(
             max_workers=max(16, 4 * conf.n_shards * conf.n_replicas))
-        self._retry_thread = threading.Thread(
-            target=self._retry_loop, daemon=True, name="msg1-retry")
-        self._retry_thread.start()
+        self._retry_thread = threads.spawn("msg1-retry",
+                                           self._retry_loop)
         self._hb_thread = None
         if use_heartbeat:
-            self._hb_thread = threading.Thread(
-                target=self._heartbeat_loop, daemon=True,
-                name="pingserver")
-            self._hb_thread.start()
+            self._hb_thread = threads.spawn("pingserver",
+                                            self._heartbeat_loop)
 
     def close(self) -> None:
         self._stop.set()

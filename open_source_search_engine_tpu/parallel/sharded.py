@@ -771,12 +771,15 @@ def suggest_sharded(sc: ShardedCollection, plan: QueryPlan) -> str | None:
              if " " not in g.display and ":" not in g.display]
     if not words:
         return None
-    live = [sc.grid[s][r].speller
-            for s in range(sc.n_shards)
-            if (r := sc.hostmap.serving_replica(s)) is not None]
-    if not live:
+    serving = [(s, r) for s in range(sc.n_shards)
+               if (r := sc.hostmap.serving_replica(s)) is not None]
+    if not serving:
         return None
-    key = (sc.mutations, tuple(id(s) for s in live))
+    live = [sc.grid[s][r].speller for s, r in serving]
+    # key on the serving (shard, replica) topology, not id(speller):
+    # CPython reuses addresses, so a dead speller's id can alias a
+    # fresh one and serve a stale merged dictionary
+    key = (sc.mutations, tuple(serving))
     cached = getattr(sc, "_merged_speller", None)
     if cached is None or cached[0] != key:
         cached = (key, merged(live))

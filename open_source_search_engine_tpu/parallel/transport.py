@@ -42,7 +42,8 @@ from typing import Callable
 
 import numpy as np
 
-from ..utils import trace as trace_mod
+from ..utils import threads, trace as trace_mod
+from ..utils.lockcheck import make_lock
 from ..utils.log import get_logger
 from ..utils.stats import g_stats
 
@@ -233,7 +234,7 @@ class _PeerState:
         #: route → RTT EWMA seconds (the pickBestHost load signal and
         #: the hedge-delay input)
         self.ewma: dict[str, float] = {}
-        self.lock = threading.Lock()
+        self.lock = make_lock("transport.peer")
 
 
 class Transport:
@@ -250,7 +251,7 @@ class Transport:
         #: client, the "old client" half of the mixed-version matrix)
         self.binary = binary
         self._peers: dict[str, _PeerState] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("transport.peers")
         #: optional hook ``fn(addr, gen)`` fed every ``X-OSSE-Gen``
         #: reply header — nodes stamp their Rdb version on every reply
         #: so the caller's cache plane observes generation moves even on
@@ -295,8 +296,8 @@ class Transport:
     def _discard(self, conn: http.client.HTTPConnection) -> None:
         try:
             conn.close()
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as exc:  # noqa: BLE001 — already-dead socket
+            log.debug("discarding connection failed: %s", exc)
 
     def close(self) -> None:
         with self._lock:
@@ -444,8 +445,9 @@ class Transport:
                 if gen_hdr is not None:
                     try:
                         obs(addr, int(gen_hdr))
-                    except Exception:  # noqa: BLE001 — observer only
-                        pass
+                    except Exception as exc:  # noqa: BLE001 — obs only
+                        g_stats.count("transport.gen_observer_error")
+                        log.warning("gen observer failed: %s", exc)
             return decode_body(data,
                                resp.headers.get("Content-Type", ""))
         raise AssertionError("unreachable")
@@ -508,9 +510,8 @@ class Transport:
             if parent is not None:
                 spans[i] = parent.child(path.lstrip("/"),
                                         addr=addrs[i], hedge=hedge)
-            threading.Thread(target=run, args=(i,), daemon=True,
-                             name=f"hedge-{path.rsplit('/', 1)[-1]}-{i}"
-                             ).start()
+            threads.spawn(f"hedge-{path.rsplit('/', 1)[-1]}-{i}",
+                          run, i)
 
         launch(0, hedge=False)
         winner, result = -1, None

@@ -381,8 +381,8 @@ def get_device_index(coll: Collection):
                     coll._di_rebuilding = False
 
         coll._di_rebuilding = True
-        threading.Thread(target=_rebuild, daemon=True,
-                         name="devindex-rebuild").start()
+        from ..utils import threads as _threads
+        _threads.spawn("devindex-rebuild", _rebuild)
     return di
 
 

@@ -26,6 +26,7 @@ from ..spider.fetcher import Fetcher
 from ..spider.loop import SpiderLoop
 from ..spider.scheduler import UrlFilterRule
 from ..spider.spiderdb import DurableSpiderScheduler
+from ..utils import threads
 from ..utils.log import get_logger
 
 log = get_logger("crawlbot")
@@ -115,12 +116,11 @@ class CrawlBot:
                 try:
                     job.loop.sched.save()
                     self.colldb.get(f"crawl_{name}").save()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as exc:  # noqa: BLE001 — job is done
+                    log.warning("crawl job %s final save failed: %s",
+                                name, exc)
 
-        job.thread = threading.Thread(target=run, daemon=True,
-                                      name=f"crawlbot-{name}")
-        job.thread.start()
+        job.thread = threads.spawn(f"crawlbot-{name}", run)
         log.info("crawl job %s started (%d seeds, max %d pages)", name,
                  len(seeds), max_pages)
         return job

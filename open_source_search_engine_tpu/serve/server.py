@@ -32,6 +32,8 @@ from pathlib import Path
 from ..index.collection import CollectionDb
 from ..query import devcheck, engine
 from ..query.summary import highlight
+from ..utils import threads
+from ..utils.lockcheck import make_lock, make_rlock
 from ..utils.log import get_logger
 from ..utils.membudget import g_membudget
 from ..utils import parms as parms_mod
@@ -67,9 +69,7 @@ class QueryBatcher:
         # (device_get releases the GIL)
         from concurrent.futures import ThreadPoolExecutor
         self._pool = ThreadPoolExecutor(2)
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="query-batcher")
-        self._thread.start()
+        self._thread = threads.spawn("query-batcher", self._loop)
 
     @property
     def alive(self) -> bool:
@@ -256,7 +256,7 @@ class SearchHTTPServer:
         # the Rdb/MemTable/caches are single-writer structures (the
         # reference's whole core is single-threaded event-driven,
         # SURVEY §1); the threaded accept plane serializes at this lock
-        self._lock = threading.RLock()
+        self._lock = make_rlock("server.core")
         #: /search micro-batching (flat device path only; the sharded
         #: and cluster planes batch at their own layers)
         self._batcher = QueryBatcher(self._run_device_batch)
@@ -271,7 +271,7 @@ class SearchHTTPServer:
         self.crawl_fetcher_factory = None
         #: AutoBan (AutoBan.cpp): per-IP query rate limiting. hits =
         #: ip → recent request timestamps; banned = ip → ban expiry
-        self._ab_lock = threading.Lock()
+        self._ab_lock = make_lock("server.autoban")
         self._ab_hits: dict[str, list[float]] = {}
         self._ab_banned: dict[str, float] = {}
         #: niceness gate: background requests yield to interactive
@@ -1191,16 +1191,13 @@ class SearchHTTPServer:
             log.info("TLS enabled (cert=%s)", cert)
         self.port = self._httpd.server_address[1]  # resolve port 0
         g_tracer.configure(host=f"{self.host}:{self.port}")
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
-        self._thread.start()
+        self._thread = threads.spawn(f"httpd-{self.port}",
+                                     self._httpd.serve_forever)
         if not self._batcher.alive:  # stop()/start() cycle
             self._batcher = QueryBatcher(self._run_device_batch)
         self._load_statsdb()
         self._stop_sampling.clear()
-        self._sampler = threading.Thread(target=self._sample_loop,
-                                         daemon=True, name="statsdb")
-        self._sampler.start()
+        self._sampler = threads.spawn("statsdb", self._sample_loop)
         log.info("http server on %s:%d", self.host, self.port)
 
     def stop(self) -> None:

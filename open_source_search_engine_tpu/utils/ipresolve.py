@@ -19,7 +19,10 @@ import socket
 import threading
 import time
 
-from . import ghash
+from . import ghash, threads
+from .log import get_logger
+
+log = get_logger("ipresolve")
 
 #: resolution cache TTL (the reference caches DNS in an RdbCache with
 #: its own TTL; 1h matches its default dns cache behavior)
@@ -103,10 +106,9 @@ def first_ip(host: str, timeout: float = 5.0) -> str:
                     box.append(socket.getaddrinfo(
                         host, None, family=socket.AF_INET,
                         type=socket.SOCK_STREAM)[0][4][0])
-                except Exception:  # noqa: BLE001
-                    pass
-            t = threading.Thread(target=_lookup, daemon=True)
-            t.start()
+                except Exception as exc:  # noqa: BLE001 — NXDOMAIN etc.
+                    log.debug("getaddrinfo(%s) failed: %s", host, exc)
+            t = threads.spawn(f"dns-{host[:24]}", _lookup)
             t.join(timeout)
             ip = box[0] if box else _pseudo_ip(host)
     except Exception:  # noqa: BLE001 — unresolvable host

@@ -21,6 +21,7 @@ import threading
 import time
 from collections import Counter
 
+from . import threads
 from .log import get_logger
 
 log = get_logger("profiler")
@@ -34,6 +35,8 @@ class SamplingProfiler:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.samples = 0
+        #: sampler-loop exceptions survived (visible in report())
+        self.sample_errors = 0
         #: (func, file, line of def) → self-time hits (top of stack)
         self.self_hits: Counter = Counter()
         #: same key → cumulative hits (anywhere on stack)
@@ -71,12 +74,11 @@ class SamplingProfiler:
             while not self._stop.wait(self.interval_s):
                 try:
                     self._sample_once()
-                except Exception:  # noqa: BLE001 — sampler must not die
-                    pass
+                except Exception as exc:  # noqa: BLE001 — keep sampling
+                    self.sample_errors += 1
+                    log.debug("profiler sample failed: %s", exc)
 
-        self._thread = threading.Thread(target=loop, daemon=True,
-                                        name="profiler")
-        self._thread.start()
+        self._thread = threads.spawn("profiler", loop)
         log.info("sampling profiler started (%.0f Hz)",
                  1.0 / self.interval_s)
 
@@ -88,6 +90,7 @@ class SamplingProfiler:
 
     def reset(self) -> None:
         self.samples = 0
+        self.sample_errors = 0
         self.self_hits.clear()
         self.cum_hits.clear()
 

@@ -1,0 +1,37 @@
+"""Named daemon-thread helpers — the one sanctioned way to spawn.
+
+Reference: the gb binary had no anonymous threads — every worker was a
+named loop registered with the Loop/BigFile thread queues, so a hung
+process could always be diagnosed from a thread dump. Our reproduction
+had drifted into a dozen ad-hoc ``threading.Thread(...)`` call sites,
+some named, some not (an unnamed thread in a py-spy dump is a dead
+end). Every spawn now flows through here; the ``thread-spawn`` osselint
+rule keeps it that way.
+
+All helper threads are daemons: background workers (SWR refreshes,
+heartbeats, samplers) must never block interpreter exit — orderly
+shutdown is the job of each owner's ``stop()``, not of ``join`` at
+teardown.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+
+def make_thread(name: str, target: Callable[..., Any], *args: Any,
+                **kwargs: Any) -> threading.Thread:
+    """A named daemon thread, NOT started (callers that must publish
+    the Thread object before it runs — batch workers whose loop checks
+    ``self._thread``)."""
+    return threading.Thread(target=target, args=args, kwargs=kwargs,
+                            daemon=True, name=name)
+
+
+def spawn(name: str, target: Callable[..., Any], *args: Any,
+          **kwargs: Any) -> threading.Thread:
+    """Create AND start a named daemon thread; returns it for joining."""
+    t = make_thread(name, target, *args, **kwargs)
+    t.start()
+    return t

@@ -434,8 +434,9 @@ class Tracer:
             with self._lock:
                 with open(path, "a", encoding="utf-8") as f:
                     f.write(json.dumps(exported) + "\n")
-        except Exception:  # noqa: BLE001 — observability never breaks serving
-            pass
+        except Exception as exc:  # noqa: BLE001 — never break serving
+            g_stats.count("trace.slowlog_errors")
+            log.debug("slowlog append failed: %s", exc)
 
     def slowlog_tail(self, n: int = 50) -> list[dict]:
         """Last ``n`` slowlog entries, skipping torn trailing lines

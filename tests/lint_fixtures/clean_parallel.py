@@ -1,0 +1,66 @@
+# osselint: path=open_source_search_engine_tpu/parallel/fixture_clean.py
+# osselint fixture — the NEGATIVE cases: idiomatic code that must lint
+# clean under the virtual parallel/ path set by the pragma above.
+from ..utils import threads, trace
+from ..utils.lockcheck import make_lock
+
+_lock = make_lock("fixture.peers")
+peers = {}
+
+
+def fetch(host, path):
+    # cross-shard HTTP through the pooled transport, not urllib
+    from .transport import g_transport
+    return g_transport.get(host, path)
+
+
+def timed_rpc():
+    with trace.timed_span("rpc.search"):
+        pass
+
+
+def cache_by_key(conf, store):
+    # identity-stable key, not id()
+    store[(conf.name, conf.generation)] = 1
+
+
+def register_peer(name):
+    with _lock:
+        peers[name] = 1  # mutation under the lock: fine
+
+
+def snapshot():
+    with _lock:
+        return dict(peers)
+
+
+def accumulate(x, acc=None):
+    if acc is None:
+        acc = []
+    acc.append(x)
+    return acc
+
+
+def spawn_named():
+    return threads.spawn("fixture-worker", snapshot)
+
+
+def guarded_cleanup(f):
+    try:
+        f()
+    except OSError:
+        pass  # specific exception: allowed
+
+
+def counted_failure(f, stats):
+    try:
+        f()
+    except Exception as exc:
+        stats.count("fixture.errors")
+        return exc
+
+
+def waived_sleep():
+    import time
+    with _lock:
+        time.sleep(0)  # osselint: ignore[blocking-under-lock] — test fixture

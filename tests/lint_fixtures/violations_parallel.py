@@ -1,0 +1,70 @@
+# osselint: path=open_source_search_engine_tpu/parallel/fixture.py
+# osselint fixture — the pragma above re-scopes it to a virtual
+# parallel/ path so every rule applies. Each "EXPECT rule" comment
+# marks the line a finding must anchor to. Never scanned by the real
+# linter (lint_fixtures/ is excluded from directory walks).
+import threading
+import time
+import urllib.request  # EXPECT urllib-in-parallel
+
+from ..utils.ttlcache import TtlCache
+
+_lock = threading.Lock()
+peers = {}
+
+
+def fetch(url):
+    return urllib.request.urlopen(url)  # EXPECT urllib-in-parallel
+
+
+def make_cache():
+    return TtlCache(max_items=64)  # EXPECT ttlcache-offplane
+
+
+def timed_rpc():
+    with g_stats.timed("rpc"):  # EXPECT bare-stats-timed
+        pass
+
+
+def cache_by_id(conf, store):
+    store[id(conf)] = 1  # EXPECT id-key
+    key = (1, tuple(id(s) for s in store))  # EXPECT id-key
+    return key
+
+
+def hold_and_sleep():
+    with _lock:
+        time.sleep(0.5)  # EXPECT blocking-under-lock
+
+
+def swallow():
+    try:
+        fetch("x")
+    except Exception:  # EXPECT silent-except
+        pass
+
+
+def swallow_bare():
+    try:
+        fetch("x")
+    except:  # EXPECT silent-except
+        raise
+
+
+def accumulate(x, acc=[]):  # EXPECT mutable-default
+    acc.append(x)
+    return acc
+
+
+def spawn_raw():
+    t = threading.Thread(target=fetch)  # EXPECT thread-spawn
+    return t
+
+
+def register_peer(name):
+    peers[name] = 1  # EXPECT locked-global
+
+
+def pull_scores(x):
+    import jax
+    return jax.device_get(x)  # EXPECT device-sync

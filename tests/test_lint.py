@@ -1,0 +1,224 @@
+"""osselint gate — the tree must be invariant-clean, fast, and the
+rules themselves must keep working.
+
+This is the tier-1 single lint gate: it replaced the string-match
+lints that used to live in test_oddments.py (urlopen-in-parallel,
+off-plane TtlCache) and test_trace.py (bare g_stats.timed on the query
+path) — those invariants are now AST rules in ``tools/osselint.py``,
+exercised here against fixtures with known-violating and known-clean
+code, plus seeded regressions for bugs this repo actually shipped
+(the PR 4 ``id(conf)`` cache key).
+"""
+
+import re
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tools import osselint
+
+ROOT = Path(__file__).resolve().parent.parent
+FIXTURES = ROOT / "tests" / "lint_fixtures"
+
+
+def _lint_file(path: Path):
+    return osselint.check_source(path.read_text(encoding="utf-8"),
+                                 path.relative_to(ROOT).as_posix())
+
+
+class TestTreeIsClean:
+    def test_zero_unwaived_findings_under_budget(self):
+        """The whole package + tools + tests lint clean in < 5s —
+        osselint is cheap enough to gate every PR."""
+        t0 = time.monotonic()
+        files = osselint.iter_py_files(osselint.default_paths(ROOT),
+                                       ROOT)
+        findings = osselint.lint_files(files, ROOT)
+        elapsed = time.monotonic() - t0
+        assert not findings, "\n".join(
+            f"{f.path}:{f.line}: {f.rule}: {f.msg}" for f in findings)
+        assert len(files) > 100, "scan missed most of the tree?"
+        assert elapsed < 5.0, f"osselint took {elapsed:.1f}s (budget 5s)"
+
+    def test_fixtures_are_excluded_from_tree_scan(self):
+        files = osselint.iter_py_files(osselint.default_paths(ROOT),
+                                       ROOT)
+        assert not any("lint_fixtures" in f.parts for f in files)
+
+
+class TestFixtures:
+    def test_every_rule_fires_where_expected(self):
+        """The violations fixture carries ``# EXPECT rule`` markers;
+        the finding set must equal the marker set exactly — no missed
+        violations, no spurious ones."""
+        src = (FIXTURES / "violations_parallel.py").read_text()
+        expected = set()
+        for i, line in enumerate(src.splitlines(), start=1):
+            for rule in re.findall(r"# EXPECT ([a-z\-]+)", line):
+                expected.add((i, rule))
+        got = {(f.line, f.rule) for f in
+               _lint_file(FIXTURES / "violations_parallel.py")}
+        assert got == expected, (
+            f"missed: {sorted(expected - got)}\n"
+            f"spurious: {sorted(got - expected)}")
+
+    def test_all_rules_covered_by_fixture(self):
+        """Every registered rule has at least one positive case."""
+        src = (FIXTURES / "violations_parallel.py").read_text()
+        covered = set(re.findall(r"# EXPECT ([a-z\-]+)", src))
+        assert covered == osselint.RULE_NAMES
+
+    def test_clean_fixture_has_no_findings(self):
+        findings = _lint_file(FIXTURES / "clean_parallel.py")
+        assert not findings, [(f.line, f.rule) for f in findings]
+
+    def test_waiver_suppresses_and_scopes_to_named_rule(self):
+        src = ("# osselint: path=open_source_search_engine_tpu/"
+               "parallel/w.py\n"
+               "import time\n"
+               "import threading\n"
+               "_lock = threading.Lock()\n"
+               "def f():\n"
+               "    with _lock:\n"
+               "        time.sleep(1)  # osselint: ignore["
+               "blocking-under-lock] — fixture\n")
+        assert osselint.check_source(src, "x.py") == []
+        # a waiver for a DIFFERENT rule must not suppress
+        wrong = src.replace("ignore[blocking-under-lock]",
+                            "ignore[id-key]")
+        found = osselint.check_source(wrong, "x.py")
+        assert [f.rule for f in found] == ["blocking-under-lock"]
+
+
+class TestSeededRegressions:
+    """Re-lint the literal bug shapes this repo shipped before."""
+
+    def test_pr4_id_conf_cache_key_is_caught(self):
+        # the PR 4 SERP-cache bug: conf keyed by id() — address reuse
+        # after GC aliases a dead conf to a live one
+        src = ("def serp_key(conf, q):\n"
+               "    return (q, id(conf))\n")
+        found = osselint.check_source(
+            src, "open_source_search_engine_tpu/parallel/sharded.py")
+        assert [f.rule for f in found] == ["id-key"]
+
+    def test_offplane_ttlcache_is_caught(self):
+        src = ("from ..utils.ttlcache import TtlCache\n"
+               "c = TtlCache(max_items=10)\n")
+        found = osselint.check_source(
+            src, "open_source_search_engine_tpu/serve/server.py")
+        assert [f.rule for f in found] == ["ttlcache-offplane"]
+        # ...but the cache plane itself may construct them
+        assert osselint.check_source(
+            src, "open_source_search_engine_tpu/cache/plane.py") == []
+
+    def test_bare_urlopen_in_parallel_is_caught(self):
+        src = ("import urllib.request\n"
+               "def get(u):\n"
+               "    return urllib.request.urlopen(u)\n")
+        found = osselint.check_source(
+            src, "open_source_search_engine_tpu/parallel/cluster.py")
+        assert {f.rule for f in found} == {"urllib-in-parallel"}
+        # transport.py is the sanctioned courier
+        assert osselint.check_source(
+            src,
+            "open_source_search_engine_tpu/parallel/transport.py") == []
+
+    def test_bare_stats_timed_on_query_path_is_caught(self):
+        src = ("def search(q):\n"
+               "    with g_stats.timed('query.total'):\n"
+               "        pass\n")
+        found = osselint.check_source(
+            src, "open_source_search_engine_tpu/query/engine.py")
+        assert [f.rule for f in found] == ["bare-stats-timed"]
+        # outside the query path the plane is free to use it
+        assert osselint.check_source(
+            src, "open_source_search_engine_tpu/utils/stats.py") == []
+
+
+class TestCli:
+    def test_violating_file_exits_nonzero_with_json(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.osselint", "--format=json",
+             str(FIXTURES / "violations_parallel.py")],
+            cwd=ROOT, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 1
+        import json
+        payload = json.loads(proc.stdout)
+        assert payload["files"] == 1
+        assert {f["rule"] for f in payload["findings"]} \
+            == osselint.RULE_NAMES
+
+    def test_clean_file_exits_zero(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.osselint",
+             str(FIXTURES / "clean_parallel.py")],
+            cwd=ROOT, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stdout
+
+    def test_changed_mode_exits_nonzero_on_findings(self, tmp_path):
+        """--changed over a scratch repo holding one violating file."""
+        repo = tmp_path / "repo"
+        pkg = repo / "open_source_search_engine_tpu" / "parallel"
+        pkg.mkdir(parents=True)
+        (pkg / "bad.py").write_text(
+            "import urllib.request\n"
+            "x = urllib.request.urlopen('http://example.com')\n")
+        for args in (["git", "init", "-q"],
+                     ["git", "add", "-A"],
+                     ["git", "-c", "user.email=t@t", "-c",
+                      "user.name=t", "commit", "-qm", "seed"]):
+            subprocess.run(args, cwd=repo, check=True,
+                           capture_output=True)
+        # modify post-commit so it shows up as changed vs. HEAD
+        (pkg / "bad.py").write_text(
+            "import urllib.request\n"
+            "y = urllib.request.urlopen('http://example.org')\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.osselint", "--changed",
+             "--root", str(repo)],
+            cwd=ROOT, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 1
+        assert "urllib-in-parallel" in proc.stdout
+        # and a clean tree (nothing changed) exits 0
+        subprocess.run(["git", "checkout", "-q", "--", "."], cwd=repo,
+                       check=True, capture_output=True)
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.osselint", "--changed",
+             "--root", str(repo)],
+            cwd=ROOT, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stdout
+
+
+class TestRuleMechanics:
+    def test_nested_closure_not_flagged_as_blocking(self):
+        """A closure DEFINED under a lock runs later — not a
+        blocking-under-lock violation."""
+        src = ("import time, threading\n"
+               "_lock = threading.Lock()\n"
+               "def f():\n"
+               "    with _lock:\n"
+               "        def later():\n"
+               "            time.sleep(1)\n"
+               "        return later\n")
+        found = osselint.check_source(
+            src, "open_source_search_engine_tpu/utils/x.py")
+        assert [f.rule for f in found] == []
+
+    def test_syntax_error_is_reported_not_raised(self):
+        found = osselint.check_source(
+            "def broken(:\n", "open_source_search_engine_tpu/x.py")
+        assert [f.rule for f in found] == ["syntax-error"]
+
+    def test_device_sync_allowed_at_the_boundary(self):
+        src = "import jax\nv = jax.device_get(x)\n"
+        assert osselint.check_source(
+            src,
+            "open_source_search_engine_tpu/query/devindex.py") == []
+        found = osselint.check_source(
+            src, "open_source_search_engine_tpu/query/engine.py")
+        assert "syntax-error" not in {f.rule for f in found}
+        assert [f.rule for f in found] == ["device-sync"]

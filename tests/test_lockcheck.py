@@ -1,0 +1,230 @@
+"""Runtime lock auditor tests — order-graph cycles, hold times, and
+blocking-call probes (:mod:`..utils.lockcheck`).
+
+These drive a private :class:`LockCheckRegistry` (never the process
+singleton) so assertions can't see edges from other tests, and they
+work regardless of whether OSSE_LOCKCHECK is set for the suite run.
+"""
+
+import threading
+import time
+
+import pytest
+
+from open_source_search_engine_tpu.utils import lockcheck
+from open_source_search_engine_tpu.utils.lockcheck import (
+    LockCheckRegistry, TrackedLock, TrackedRLock,
+)
+from open_source_search_engine_tpu.utils.stats import g_stats
+
+
+@pytest.fixture
+def reg():
+    return LockCheckRegistry()
+
+
+class TestOrderGraph:
+    def test_nested_acquire_records_edge(self, reg):
+        a = TrackedLock("A", reg)
+        b = TrackedLock("B", reg)
+        with a:
+            with b:
+                pass
+        assert reg.edges == {"A": {"B"}}
+        assert reg.cycles == []
+        info = reg.edge_info[("A", "B")]
+        assert threading.current_thread().name in info
+
+    def test_ab_then_ba_is_a_cycle(self, reg):
+        """The classic potential deadlock: one code path takes A→B,
+        another B→A. Neither run deadlocks alone; the auditor must
+        still flag the pair."""
+        a = TrackedLock("A", reg)
+        b = TrackedLock("B", reg)
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert len(reg.cycles) == 1
+        cycle = reg.cycles[0]
+        assert set(cycle) == {"A", "B"}
+        # the cycle is also visible in the serialized report
+        assert reg.report()["cycles"] == [cycle]
+
+    def test_transitive_cycle_detected(self, reg):
+        """A→B, B→C, then C→A closes a 3-lock loop."""
+        a, b, c = (TrackedLock(n, reg) for n in "ABC")
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with c:
+            with a:
+                pass
+        assert len(reg.cycles) == 1
+        assert set(reg.cycles[0]) == {"A", "B", "C"}
+
+    def test_same_name_reentry_is_not_an_edge(self, reg):
+        """Two instances of one lock ROLE (e.g. two per-Rdb locks)
+        produce no self-edge — the convention is per role name."""
+        a1 = TrackedLock("rdb", reg)
+        a2 = TrackedLock("rdb", reg)
+        with a1:
+            with a2:
+                pass
+        assert reg.edges == {}
+        assert reg.cycles == []
+
+    def test_consistent_order_never_cycles(self, reg):
+        a = TrackedLock("A", reg)
+        b = TrackedLock("B", reg)
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert reg.edges == {"A": {"B"}}
+        assert reg.cycles == []
+
+    def test_cross_thread_edges_combine(self, reg):
+        """Thread 1 takes A→B, thread 2 takes B→A: the graph is
+        global, so the cycle is still caught."""
+        a = TrackedLock("A", reg)
+        b = TrackedLock("B", reg)
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        def t2():
+            with b:
+                with a:
+                    pass
+        th = threading.Thread(target=t1, daemon=True)
+        th.start()
+        th.join()
+        th = threading.Thread(target=t2, daemon=True)
+        th.start()
+        th.join()
+        assert len(reg.cycles) == 1
+
+
+class TestHeldSetAndHoldTimes:
+    def test_held_is_per_thread_and_ordered(self, reg):
+        a = TrackedLock("outer", reg)
+        b = TrackedLock("inner", reg)
+        with a:
+            with b:
+                assert reg.held() == ["outer", "inner"]
+            assert reg.held() == ["outer"]
+        assert reg.held() == []
+        seen = []
+        t = threading.Thread(target=lambda: seen.extend(reg.held()),
+                             daemon=True)
+        with a:
+            t.start()
+            t.join()
+        assert seen == []  # other thread holds nothing
+
+    def test_release_records_hold_time_stat(self, reg):
+        name = "lockcheck-test-hold"
+        before = g_stats.snapshot()["latencies"].get(
+            f"lock.{name}.held_ms", {}).get("count", 0)
+        lk = TrackedLock(name, reg)
+        with lk:
+            time.sleep(0.002)
+        snap = g_stats.snapshot()["latencies"][f"lock.{name}.held_ms"]
+        assert snap["count"] == before + 1
+
+    def test_rlock_reentry_tracks_outermost_only(self, reg):
+        lk = TrackedRLock("R", reg)
+        other = TrackedLock("S", reg)
+        with lk:
+            with lk:  # re-entry: no new ordering info
+                assert reg.held() == ["R"]
+                with other:
+                    pass
+            assert reg.held() == ["R"]
+        assert reg.held() == []
+        assert reg.edges == {"R": {"S"}}
+
+    def test_acquire_release_protocol(self, reg):
+        lk = TrackedLock("P", reg)
+        assert lk.acquire() is True
+        assert lk.locked()
+        lk.release()
+        assert not lk.locked()
+        assert lk.acquire(blocking=False) is True
+        lk.release()
+
+
+@pytest.fixture
+def probed(reg):
+    """Point the probes at the test registry, restoring whatever was
+    installed before (under OSSE_LOCKCHECK=1 the suite itself runs
+    with global probes on — install_probes is idempotent, so the test
+    must swap them out, not stack on top)."""
+    was_global = lockcheck._probes_installed
+    lockcheck.uninstall_probes()
+    lockcheck.install_probes(reg)
+    yield reg
+    lockcheck.uninstall_probes()
+    if was_global:
+        lockcheck.install_probes()
+
+
+class TestBlockingProbes:
+    def test_sleep_under_lock_is_flagged(self, reg, probed):
+        lk = TrackedLock("nap", reg)
+        with lk:
+            time.sleep(0)
+        assert len(reg.blocking) == 1
+        ev = reg.blocking[0]
+        assert ev["call"] == "time.sleep"
+        assert ev["held"] == ["nap"]
+
+    def test_sleep_without_lock_is_not_flagged(self, reg, probed):
+        time.sleep(0)
+        assert reg.blocking == []
+
+    def test_uninstall_restores_originals(self, probed):
+        probe_sleep = time.sleep
+        lockcheck.uninstall_probes()
+        try:
+            assert time.sleep is not probe_sleep
+            assert not lockcheck._probes_installed
+        finally:
+            lockcheck.install_probes(probed)
+
+
+class TestFactoryGating:
+    def test_factories_match_env_gate(self):
+        a = lockcheck.make_lock("gate-test")
+        b = lockcheck.make_rlock("gate-test-r")
+        if lockcheck.ENABLED:
+            assert isinstance(a, TrackedLock)
+            assert isinstance(b, TrackedRLock)
+        else:
+            # plain primitives: zero audit overhead when off
+            assert not isinstance(a, TrackedLock)
+            assert not isinstance(b, TrackedLock)
+        # both support the context protocol either way
+        with a:
+            pass
+        with b:
+            with b:
+                pass
+
+    def test_reset_clears_registry(self, reg):
+        a = TrackedLock("A", reg)
+        b = TrackedLock("B", reg)
+        with a:
+            with b:
+                pass
+        reg.reset()
+        assert reg.report() == {"edges": {}, "edge_info": {},
+                                "cycles": [], "blocking": []}

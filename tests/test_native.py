@@ -66,3 +66,25 @@ class TestNativeCore:
             posdb.unpack(merged.keys)["docid"][0] == 43
         # the tombstone must have killed docid 42's posting
         assert int(posdb.unpack(merged.keys)["docid"][0]) == 43
+
+
+@pytest.mark.slow
+class TestSanitizerParity:
+    """ASan+UBSan-instrumented natives pass the same parity checks
+    (OSSE_NATIVE_SAN=1 plane): memory errors / UB in rdbcore.cpp or
+    doccore.cpp abort the driver instead of corrupting an index."""
+
+    def test_asan_ubsan_parity_clean(self):
+        import subprocess
+        import sys
+        from pathlib import Path
+        root = Path(__file__).resolve().parent.parent
+        from tools.native_san_check import _sanitizer_libs
+        if not _sanitizer_libs():
+            pytest.skip("libasan/libubsan not found by g++")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.native_san_check"],
+            cwd=root, capture_output=True, text=True, timeout=420)
+        assert proc.returncode == 0, \
+            f"sanitizer parity failed:\n{proc.stdout}\n{proc.stderr}"
+        assert "OK" in proc.stdout

@@ -189,40 +189,3 @@ class TestAlerting:
         # no wait) — their appends may land in either order
         assert sorted(ln.split()[0] for ln in lines) == \
             ["dead", "recovered"]
-
-
-class TestTransportLint:
-    def test_no_raw_urlopen_in_parallel_package(self):
-        """Every cluster RPC must flow through the pooled transport —
-        a stray ``urllib.request.urlopen`` in ``parallel/`` would dial
-        a fresh TCP connection per call, bypassing the keep-alive pool,
-        the RTT EWMAs, and the ``transport.*`` stats."""
-        from pathlib import Path
-
-        import open_source_search_engine_tpu.parallel as par
-        for py in Path(par.__file__).parent.glob("*.py"):
-            if py.name == "transport.py":
-                continue  # the one sanctioned courier (http.client)
-            text = py.read_text(encoding="utf-8")
-            assert "urlopen" not in text, (
-                f"{py.name} bypasses the pooled transport")
-
-
-class TestCachePlaneLint:
-    def test_no_ad_hoc_ttlcache_outside_the_plane(self):
-        """Every cache belongs on the cache plane — registered,
-        membudget-charged, generation-invalidated, and visible on
-        ``/admin/cache``. A raw ``TtlCache(`` construction anywhere
-        else is an unaccounted cache the pressure handler can't shed
-        and the admin page can't see."""
-        from pathlib import Path
-
-        import open_source_search_engine_tpu as pkg
-        root = Path(pkg.__file__).parent
-        for py in root.rglob("*.py"):
-            rel = py.relative_to(root).as_posix()
-            if rel.startswith("cache/") or rel == "utils/ttlcache.py":
-                continue
-            text = py.read_text(encoding="utf-8")
-            assert "TtlCache(" not in text, (
-                f"{rel} constructs an off-plane TtlCache")

@@ -7,8 +7,9 @@ threads via explicit parent handoff, cross-host propagation
 rebased client-side), the slowlog file, and the acceptance scenario —
 a 2-shard cluster with a wedged primary produces ONE assembled trace
 holding both shards' ``rpc/search`` legs with the hedge winner tagged.
-Plus the lint guard: no bare ``g_stats.timed`` left on the query path
-(``trace.timed_span`` feeds both planes so they cannot drift).
+The no-bare-``g_stats.timed``-on-the-query-path guard now lives in
+``tools/osselint.py`` (rule ``bare-stats-timed``, gated by
+``tests/test_lint.py``).
 """
 
 import json
@@ -359,26 +360,6 @@ def test_statsdb_corrupt_lines_tolerated(tmp_path):
     srv._load_statsdb()
     assert len(g_stats.timeseries) == 2
     assert g_stats.snapshot()["counters"]["statsdb.corrupt_lines"] == 1
-
-
-# ---------------------------------------------------------------------------
-# lint: the two timing planes cannot drift
-# ---------------------------------------------------------------------------
-
-def test_query_path_has_no_bare_g_stats_timed():
-    """Every query-path timer must be a trace.timed_span (which feeds
-    g_stats AND the trace) — a bare g_stats.timed would time a stage
-    the waterfall can't see."""
-    pkg = Path(cl.__file__).resolve().parent.parent
-    offenders = []
-    for rel in ("query", "parallel", "serve"):
-        for py in sorted((pkg / rel).glob("*.py")):
-            for i, line in enumerate(py.read_text().splitlines(), 1):
-                if re.search(r"\bg_stats\.timed\(", line):
-                    offenders.append(f"{py.name}:{i}")
-    assert not offenders, (
-        f"bare g_stats.timed on the query path (use trace.timed_span): "
-        f"{offenders}")
 
 
 def test_timed_span_feeds_both_planes():

@@ -1,0 +1,597 @@
+"""osselint — the project's AST invariant linter.
+
+Every rule here encodes a bug class this repo has actually shipped (or a
+reference-engine discipline that keeps it from shipping one):
+
+* ``ttlcache-offplane`` — PR 4 unified caching onto the cache plane
+  (generation invalidation + single-flight); a raw ``TtlCache(`` off the
+  plane silently serves stale entries across index generations.
+* ``urllib-in-parallel`` — all cross-shard HTTP rides the pooled
+  ``parallel/transport.py`` (hedging, tracing, connection reuse); a bare
+  ``urlopen`` bypasses every one of those.
+* ``bare-stats-timed`` — the query path must use ``trace.timed_span``
+  (which also feeds g_stats) so cross-shard waterfalls stay complete; a
+  bare ``g_stats.timed`` records a duration no trace can attribute.
+* ``id-key`` — PR 4 shipped an ``id(conf)`` cache key: CPython reuses
+  addresses after GC, so a dead object's id aliases a live one and the
+  cache returns wrong-config results. ``id()`` never belongs in a key.
+* ``blocking-under-lock`` — sleeping or doing socket/subprocess I/O
+  inside a ``with <lock>:`` body stalls every thread behind the lock.
+* ``silent-except`` — ``except: pass`` ate real corruption reports more
+  than once; failures must at least count or log.
+* ``mutable-default`` — the classic shared-default-argument aliasing.
+* ``thread-spawn`` — threads come from ``utils.threads`` so every one is
+  a *named daemon*: names make lockcheck/profiler output readable and
+  daemonization keeps test runs from hanging on shutdown.
+* ``locked-global`` — module-level mutable state in ``serve/`` and
+  ``parallel/`` is shared across request threads; mutations outside a
+  ``with <lock>:`` are data races.
+* ``device-sync`` — ``jax.device_get``/``block_until_ready`` force a
+  host sync; outside the two blessed device-boundary modules they
+  silently serialize the TPU pipeline.
+
+Waive a finding with a trailing comment on its line::
+
+    risky_call()  # osselint: ignore[rule-name] — why it is safe here
+
+``python -m tools.osselint`` scans the package + tools + tests;
+``--changed`` scans only files touched vs. git HEAD; ``--format=json``
+emits machine-readable findings. Exit status 1 when anything unwaived
+is found.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+import sys
+from dataclasses import dataclass
+from pathlib import Path
+
+PKG = "open_source_search_engine_tpu"
+
+#: dirs never scanned (fixtures are deliberate violations)
+EXCLUDE_PARTS = {"__pycache__", "lint_fixtures", ".git"}
+
+_WAIVER_RE = re.compile(r"osselint:\s*ignore\[([A-Za-z0-9_\-,\s]+)\]")
+
+#: a ``# osselint: path=<relpath>`` comment in the first lines of a
+#: file re-scopes it to that virtual path (fixtures exercise
+#: parallel/-only rules from tests/lint_fixtures/)
+_PATH_PRAGMA_RE = re.compile(r"osselint:\s*path=(\S+)")
+
+#: ``with`` context expressions whose final identifier matches this are
+#: treated as lock acquisitions by blocking-under-lock / locked-global
+_LOCKISH_RE = re.compile(r"lock|mutex|cond|(^|_)cv$", re.IGNORECASE)
+
+#: dotted-call prefixes that block the calling thread
+_BLOCKING_PREFIXES = ("socket.", "urllib.", "subprocess.")
+_BLOCKING_EXACT = {"time.sleep", "sleep"}
+
+#: mutating container methods for locked-global
+_MUTATORS = {"append", "add", "update", "pop", "popitem", "clear",
+             "extend", "remove", "discard", "setdefault", "insert"}
+
+#: cache-ish methods whose key args must not contain id()
+_CACHE_METHODS = {"get", "put", "setdefault", "get_or_compute"}
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    rule: str
+    msg: str
+
+    def as_dict(self) -> dict:
+        return {"path": self.path, "line": self.line,
+                "rule": self.rule, "msg": self.msg}
+
+
+class Ctx:
+    """One parsed file: tree + parent links + per-line waivers."""
+
+    def __init__(self, src: str, rel: str):
+        self.rel = rel.replace("\\", "/")
+        self.tree = ast.parse(src)
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self.waivers: dict[int, set[str]] = {}
+        for i, line in enumerate(src.splitlines(), start=1):
+            m = _WAIVER_RE.search(line)
+            if m:
+                self.waivers[i] = {r.strip() for r in
+                                   m.group(1).split(",") if r.strip()}
+
+    def ancestors(self, node: ast.AST):
+        """(child, parent) pairs walking from ``node`` to the root."""
+        cur = node
+        while True:
+            parent = self.parents.get(cur)
+            if parent is None:
+                return
+            yield cur, parent
+            cur = parent
+
+
+def dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _final_ident(node: ast.AST) -> str | None:
+    """Last identifier of an expression (``self._lock`` → ``_lock``)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call):
+        return _final_ident(node.func)
+    return None
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    ident = _final_ident(expr)
+    return ident is not None and bool(_LOCKISH_RE.search(ident))
+
+
+def _under_lock(ctx: Ctx, node: ast.AST) -> bool:
+    """Is ``node`` lexically inside a ``with <lock>:`` body?"""
+    for _child, parent in ctx.ancestors(node):
+        if isinstance(parent, (ast.With, ast.AsyncWith)):
+            if any(_is_lockish(item.context_expr)
+                   for item in parent.items):
+                return True
+    return False
+
+
+def _body_calls(body: list[ast.stmt]):
+    """Every Call lexically in ``body``, NOT descending into nested
+    function/lambda definitions (closures run later, not here)."""
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# rules: each is (name, path-predicate, checker(ctx) -> [Finding])
+# ---------------------------------------------------------------------------
+
+def _in_pkg(rel: str) -> bool:
+    return rel.startswith(PKG + "/")
+
+
+def _scope_pkg_tools(rel: str) -> bool:
+    return _in_pkg(rel) or rel.startswith("tools/")
+
+
+def rule_ttlcache_offplane(ctx: Ctx) -> list[Finding]:
+    out = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name and name.split(".")[-1] == "TtlCache":
+                out.append(Finding(
+                    ctx.rel, node.lineno, "ttlcache-offplane",
+                    "raw TtlCache() off the cache plane — use "
+                    "cache.plane (generation invalidation, "
+                    "single-flight)"))
+    return out
+
+
+def _ttl_scope(rel: str) -> bool:
+    return _in_pkg(rel) and rel not in (
+        f"{PKG}/cache/plane.py", f"{PKG}/utils/ttlcache.py")
+
+
+def rule_urllib_in_parallel(ctx: Ctx) -> list[Finding]:
+    out = []
+    for node in ast.walk(ctx.tree):
+        bad = None
+        if isinstance(node, ast.Import):
+            if any(a.name.split(".")[0] == "urllib" for a in node.names):
+                bad = "import urllib"
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.module.split(".")[0] == "urllib":
+                bad = f"from {node.module} import ..."
+        elif isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name and name.split(".")[-1] == "urlopen":
+                bad = "urlopen()"
+        if bad:
+            out.append(Finding(
+                ctx.rel, node.lineno, "urllib-in-parallel",
+                f"{bad} in parallel/ — all cross-shard HTTP goes "
+                "through transport.py (pooling, hedging, tracing)"))
+    return out
+
+
+def _urllib_scope(rel: str) -> bool:
+    return (rel.startswith(f"{PKG}/parallel/")
+            and not rel.endswith("/transport.py"))
+
+
+def rule_bare_stats_timed(ctx: Ctx) -> list[Finding]:
+    out = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) \
+                and dotted(node.func) == "g_stats.timed":
+            out.append(Finding(
+                ctx.rel, node.lineno, "bare-stats-timed",
+                "bare g_stats.timed() on the query path — use "
+                "trace.timed_span (feeds stats AND the waterfall)"))
+    return out
+
+
+def _timed_scope(rel: str) -> bool:
+    return any(rel.startswith(f"{PKG}/{d}/")
+               for d in ("query", "parallel", "serve"))
+
+
+def rule_id_key(ctx: Ctx) -> list[Finding]:
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "id"):
+            continue
+        keyish = False
+        for child, parent in ctx.ancestors(node):
+            if isinstance(parent, ast.Tuple):
+                keyish = True
+            elif isinstance(parent, ast.Dict) and child in parent.keys:
+                keyish = True
+            elif isinstance(parent, ast.Subscript) \
+                    and child is parent.slice:
+                keyish = True
+            elif isinstance(parent, ast.Call) and child is not parent.func:
+                ident = _final_ident(parent.func)
+                if ident in _CACHE_METHODS:
+                    keyish = True
+            if keyish:
+                break
+        if keyish:
+            out.append(Finding(
+                ctx.rel, node.lineno, "id-key",
+                "id() in a cache/dict key — CPython reuses addresses "
+                "after GC, so dead objects alias live ones (the PR 4 "
+                "id(conf) bug); key on identity-stable values"))
+    return out
+
+
+def rule_blocking_under_lock(ctx: Ctx) -> list[Finding]:
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        if not any(_is_lockish(item.context_expr)
+                   for item in node.items):
+            continue
+        for call in _body_calls(node.body):
+            name = dotted(call.func)
+            if name is None:
+                continue
+            if name in _BLOCKING_EXACT \
+                    or name.startswith(_BLOCKING_PREFIXES):
+                out.append(Finding(
+                    ctx.rel, call.lineno, "blocking-under-lock",
+                    f"{name}() inside a `with lock:` body — every "
+                    "thread behind the lock stalls for the call"))
+    return out
+
+
+def rule_silent_except(ctx: Ctx) -> list[Finding]:
+    out = []
+
+    def broad(t: ast.AST | None) -> bool:
+        if t is None:
+            return True
+        if isinstance(t, ast.Name):
+            return t.id in ("Exception", "BaseException")
+        if isinstance(t, ast.Tuple):
+            return any(broad(e) for e in t.elts)
+        return False
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            out.append(Finding(
+                ctx.rel, node.lineno, "silent-except",
+                "bare `except:` — catches KeyboardInterrupt/SystemExit "
+                "too; name the exception"))
+        elif broad(node.type) and len(node.body) == 1 \
+                and isinstance(node.body[0], ast.Pass):
+            out.append(Finding(
+                ctx.rel, node.lineno, "silent-except",
+                "`except Exception: pass` — failures must at least "
+                "count (g_stats) or log"))
+    return out
+
+
+def rule_mutable_default(ctx: Ctx) -> list[Finding]:
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        defaults = list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None]
+        for d in defaults:
+            mutable = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(d, ast.Call)
+                and isinstance(d.func, ast.Name)
+                and d.func.id in ("list", "dict", "set"))
+            if mutable:
+                out.append(Finding(
+                    ctx.rel, d.lineno, "mutable-default",
+                    "mutable default argument — shared across every "
+                    "call; default to None and create inside"))
+    return out
+
+
+def rule_thread_spawn(ctx: Ctx) -> list[Finding]:
+    out = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name and (name == "Thread"
+                         or name.endswith(".Thread")):
+                out.append(Finding(
+                    ctx.rel, node.lineno, "thread-spawn",
+                    "raw threading.Thread — use utils.threads.spawn/"
+                    "make_thread (named daemon threads; lockcheck and "
+                    "the profiler need the names)"))
+    return out
+
+
+def _thread_scope(rel: str) -> bool:
+    return _in_pkg(rel) and rel != f"{PKG}/utils/threads.py"
+
+
+def rule_locked_global(ctx: Ctx) -> list[Finding]:
+    mutables: set[str] = set()
+    for stmt in ctx.tree.body:
+        targets: list[ast.expr] = []
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        else:
+            continue
+        is_mut = isinstance(value, (ast.Dict, ast.List, ast.Set,
+                                    ast.ListComp, ast.DictComp,
+                                    ast.SetComp)) or (
+            isinstance(value, ast.Call)
+            and _final_ident(value.func) in ("dict", "list", "set",
+                                             "defaultdict",
+                                             "OrderedDict", "deque",
+                                             "Counter"))
+        if not is_mut:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                mutables.add(t.id)
+    if not mutables:
+        return []
+
+    out = []
+
+    def in_function(node: ast.AST) -> bool:
+        return any(isinstance(p, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef))
+                   for _c, p in ctx.ancestors(node))
+
+    def flag(node: ast.AST, name: str) -> None:
+        if in_function(node) and not _under_lock(ctx, node):
+            out.append(Finding(
+                ctx.rel, node.lineno, "locked-global",
+                f"module-level mutable `{name}` mutated outside a "
+                "`with lock:` — request threads share it"))
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+            targets = node.targets if isinstance(node, (ast.Assign,
+                                                        ast.Delete)) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id in mutables:
+                    flag(node, t.value.id)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in mutables \
+                and node.func.attr in _MUTATORS:
+            flag(node, node.func.value.id)
+    return out
+
+
+def _locked_global_scope(rel: str) -> bool:
+    return rel.startswith((f"{PKG}/serve/", f"{PKG}/parallel/"))
+
+
+def rule_device_sync(ctx: Ctx) -> list[Finding]:
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        hit = None
+        if name and name.split(".")[-1] == "device_get":
+            hit = "device_get"
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "block_until_ready":
+            hit = "block_until_ready"
+        if hit:
+            out.append(Finding(
+                ctx.rel, node.lineno, "device-sync",
+                f"{hit} outside the device boundary — host syncs "
+                "serialize the TPU pipeline; keep them in "
+                "query/devindex.py or query/scorer.py"))
+    return out
+
+
+def _device_scope(rel: str) -> bool:
+    return _in_pkg(rel) and rel not in (
+        f"{PKG}/query/devindex.py", f"{PKG}/query/scorer.py")
+
+
+#: (rule-name, path predicate, checker)
+RULES = [
+    ("ttlcache-offplane", _ttl_scope, rule_ttlcache_offplane),
+    ("urllib-in-parallel", _urllib_scope, rule_urllib_in_parallel),
+    ("bare-stats-timed", _timed_scope, rule_bare_stats_timed),
+    ("id-key", _in_pkg, rule_id_key),
+    ("blocking-under-lock", _in_pkg, rule_blocking_under_lock),
+    ("silent-except", _scope_pkg_tools, rule_silent_except),
+    ("mutable-default", _scope_pkg_tools, rule_mutable_default),
+    ("thread-spawn", _thread_scope, rule_thread_spawn),
+    ("locked-global", _locked_global_scope, rule_locked_global),
+    ("device-sync", _device_scope, rule_device_sync),
+]
+
+RULE_NAMES = {name for name, _p, _c in RULES}
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def check_source(src: str, rel: str) -> list[Finding]:
+    """Lint one source text as if it lived at ``rel`` (posix relative
+    path — rule scoping keys off it). The fixture/test entry point."""
+    rel = rel.replace("\\", "/")
+    for line in src.splitlines()[:5]:
+        m = _PATH_PRAGMA_RE.search(line)
+        if m:
+            rel = m.group(1)
+            break
+    try:
+        ctx = Ctx(src, rel)
+    except SyntaxError as exc:
+        return [Finding(rel, exc.lineno or 1, "syntax-error", str(exc))]
+    findings: list[Finding] = []
+    for name, pred, checker in RULES:
+        if not pred(rel):
+            continue
+        for f in checker(ctx):
+            if name in ctx.waivers.get(f.line, ()):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.line, f.rule))
+    return findings
+
+
+def default_paths(root: Path) -> list[Path]:
+    return [root / PKG, root / "tools", root / "tests"]
+
+
+def iter_py_files(paths: list[Path], root: Path) -> list[Path]:
+    out: list[Path] = []
+    for p in paths:
+        if p.is_file() and p.suffix == ".py":
+            out.append(p)
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if not EXCLUDE_PARTS & set(f.relative_to(root).parts):
+                    out.append(f)
+    return out
+
+
+def changed_files(root: Path) -> list[Path]:
+    """Files touched vs. HEAD: unstaged + staged + untracked."""
+    import subprocess
+    names: set[str] = set()
+    for args in (["git", "diff", "--name-only", "HEAD"],
+                 ["git", "diff", "--name-only", "--cached"],
+                 ["git", "ls-files", "--others", "--exclude-standard"]):
+        proc = subprocess.run(args, cwd=root, capture_output=True,
+                              text=True, check=False)
+        names.update(line.strip() for line in proc.stdout.splitlines()
+                     if line.strip())
+    out = []
+    for n in sorted(names):
+        p = root / n
+        if p.suffix == ".py" and p.exists() \
+                and not (EXCLUDE_PARTS & set(Path(n).parts)):
+            out.append(p)
+    return out
+
+
+def lint_files(files: list[Path], root: Path) -> list[Finding]:
+    findings: list[Finding] = []
+    for f in files:
+        rel = f.relative_to(root).as_posix()
+        try:
+            src = f.read_text(encoding="utf-8")
+        except OSError as exc:
+            findings.append(Finding(rel, 1, "unreadable", str(exc)))
+            continue
+        findings.extend(check_source(src, rel))
+    return findings
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="osselint", description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: package + "
+                         "tools + tests)")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only files changed vs. git HEAD")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: this file's repo)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name, _pred, checker in RULES:
+            doc = (checker.__doc__ or "").strip().splitlines()
+            print(f"{name}: {doc[0] if doc else ''}")
+        return 0
+
+    root = Path(args.root).resolve() if args.root \
+        else Path(__file__).resolve().parent.parent
+    if args.changed:
+        files = changed_files(root)
+    elif args.paths:
+        files = iter_py_files([Path(p).resolve() for p in args.paths],
+                              root)
+    else:
+        files = iter_py_files(default_paths(root), root)
+
+    findings = lint_files(files, root)
+    if args.format == "json":
+        print(json.dumps({"files": len(files),
+                          "findings": [f.as_dict() for f in findings]},
+                         indent=2))
+    else:
+        for f in findings:
+            print(f"{f.path}:{f.line}: {f.rule}: {f.msg}")
+        print(f"osselint: {len(files)} files, "
+              f"{len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
