@@ -463,6 +463,92 @@ def main_trace() -> None:
         sys.exit(1)
 
 
+def main_dispatch() -> None:
+    """Resident-loop microbench (BENCH_DISPATCH=1): steady-state
+    enqueue-to-result latency through the double-buffered serving loop
+    (query/resident.py) plus the packed-layout HBM model. Two numbers,
+    one budget:
+
+    * p50/p99 of ticket enqueue→resolve with the pipeline kept at
+      depth 2 (the next wave is enqueued before the previous resolves
+      — the dispatch-RTT-floor attack this loop exists for);
+    * modelled HBM bytes/query for the live packed layout (f16
+      impacts, uint8 doc meta, length-bucketed Lsp tiles) vs the
+      legacy unpacked layout — the SURVEY §7 stage-8 win. The packed/
+      legacy ratio must be ≤ 0.7 or this exits 1.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from collections import deque
+
+    from open_source_search_engine_tpu.build import docproc
+    from open_source_search_engine_tpu.index.collection import Collection
+    from open_source_search_engine_tpu.query import engine
+    from open_source_search_engine_tpu.query.engine import (
+        get_device_index, get_resident_loop)
+
+    bdir = tempfile.mkdtemp(prefix="osse_bench_disp_")
+    coll = Collection("dispbench", bdir)
+    docproc.index_batch(coll, [
+        (f"http://bench.test/d{d}",
+         f"<html><body><p>dispatch bench words filler token{d % 37} "
+         f"extra{d % 11} rare{d % 101}</p></body></html>")
+        for d in range(int(os.environ.get("BENCH_DISPATCH_DOCS",
+                                          "240")))])
+    di = get_device_index(coll)
+    # zipf-ish mix: head terms (every doc), mid (1/37), tail (1/101) —
+    # unique strings so no cache can fake the latency (module honesty
+    # note)
+    n_q = int(os.environ.get("BENCH_DISPATCH_QUERIES", "192"))
+    qs = [f"bench token{k % 37}" if k % 3 else f"words rare{k % 101}"
+          for k in range(n_q)]
+    plans = [engine._compile_cached(q, 0) for q in qs]
+
+    loop = get_resident_loop(coll)
+    # warm the shape buckets + the loop itself out of the measurement
+    for p in plans[:8]:
+        loop.submit([p], topk=64).wait(timeout=120)
+
+    lats: list[float] = []
+    inflight: deque = deque()
+    t_all = time.perf_counter()
+    for p in plans:
+        inflight.append((loop.submit([p], topk=64),
+                         time.perf_counter()))
+        while len(inflight) >= 2:  # keep depth-2 steady state
+            tk, t0 = inflight.popleft()
+            tk.wait(timeout=120)
+            lats.append(time.perf_counter() - t0)
+    while inflight:
+        tk, t0 = inflight.popleft()
+        tk.wait(timeout=120)
+        lats.append(time.perf_counter() - t0)
+    wall = time.perf_counter() - t_all
+
+    lats.sort()
+    p50 = 1000 * lats[len(lats) // 2]
+    p99 = 1000 * lats[min(len(lats) - 1, int(len(lats) * 0.99))]
+
+    dplans = [di.plan(p) for p in plans]
+    packed_b = di.wave_bytes_per_query(dplans, packed=True)
+    legacy_b = di.wave_bytes_per_query(dplans, packed=False)
+    ratio = packed_b / legacy_b
+
+    ok = ratio <= 0.7
+    print(json.dumps({
+        "metric": "dispatch_enqueue_to_result_p50_ms",
+        "value": round(p50, 2), "unit": "ms",
+        "p99_ms": round(p99, 2),
+        "queries": len(lats), "qps": round(len(lats) / wall, 1),
+        "waves": loop.waves_issued,
+        "hbm_bytes_per_query_packed": round(packed_b),
+        "hbm_bytes_per_query_legacy": round(legacy_b),
+        "packed_ratio": round(ratio, 3),
+        "ok": ok, "budget_ratio": 0.7,
+    }))
+    if not ok:
+        sys.exit(1)
+
+
 def main() -> None:
     try:
         jax = _init_backend()
@@ -746,5 +832,7 @@ if __name__ == "__main__":
         main_cache()
     elif os.environ.get("BENCH_TRACE"):
         main_trace()
+    elif os.environ.get("BENCH_DISPATCH"):
+        main_dispatch()
     else:
         main()

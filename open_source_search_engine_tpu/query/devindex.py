@@ -86,6 +86,7 @@ plus dense/cube rows. Beyond that the corpus must shard
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass
 from functools import partial
@@ -102,8 +103,9 @@ from ..utils import trace
 from ..utils.log import get_logger
 from . import devcheck, weights
 from .compiler import SUB_SYNONYM, QueryPlan, compile_query
-from .packer import (MAX_POSITIONS, T_FLOOR, TABLE_SIZE, _bucket, _pad1,
-                     group_flags, pack_payload, pad_table)
+from .packer import (IMPACT_SCALE, MAX_POSITIONS, T_FLOOR, TABLE_SIZE,
+                     _bucket, _pad1, demote_impacts, group_flags,
+                     pack_payload, pad_table)
 from .scorer import final_multipliers, min_scores, presence_table_ok
 
 log = get_logger("devindex")
@@ -111,8 +113,13 @@ log = get_logger("devindex")
 #: shape-bucket floors (distinct shape tuples = one XLA compile each)
 RD_FLOOR = 4      # dense rows
 RS_FLOOR = 4      # sparse rows
-LSP_FLOOR = 2048  # sparse gather lanes — single bucket when the dense
-                  # threshold (D_cap//64) keeps every sparse run under it
+#: sparse gather lane buckets (length-bucketed termlist tiles, SURVEY
+#: §7 stage-8): waves whose longest sparse run is short ride a short
+#: tile instead of paying the full 2048-lane gather per row — the
+#: padding bytes were most of the sparse HBM traffic for everyday
+#: queries (the dense threshold D_cap//64 keeps runs under the top)
+LSP_BUCKETS = (128, 512, 2048)
+LSP_FLOOR = LSP_BUCKETS[0]
 B_FLOOR = 4
 KAPPA_FLOOR = 256  # phase-2 candidate count
 DOC_UPD_FLOOR = 64
@@ -357,9 +364,9 @@ def _build_dense_rows(d_doc, d_imp, d_rs, d_cnt, starts, cum,
                    d_doc.shape[0] - 1)
     valid = lane < cum[-1]
     doc = d_doc[src].astype(jnp.int32)
-    # dst fits int32: V·D ≤ DENSE_BUDGET/9 < 2^31
+    # dst fits int32: V·D ≤ DENSE_BUDGET/7 < 2^31
     dst = jnp.where(valid, row * D + doc, V * D)
-    imp = jnp.zeros((V * D,), jnp.float32).at[dst].set(
+    imp = jnp.zeros((V * D,), d_imp.dtype).at[dst].set(
         d_imp[src], mode="drop")
     rs = jnp.zeros((V * D,), jnp.int32).at[dst].set(
         d_rs[src], mode="drop")
@@ -451,6 +458,25 @@ class ResidentPlan:
     #: number of scored∧required groups (the single definition every
     #: routing/k2/κ decision keys on)
     n_scored: int = 0
+
+
+@dataclass
+class PendingBatch:
+    """One issued-but-unfetched batch: waves are on the device queue,
+    no output has been synced. Produced by ``issue_batch`` (pure async
+    enqueue), consumed by ``collect_batch`` (the one host sync). The
+    resident serving loop holds up to two of these so batch N+1's
+    dispatch rides under batch N's compute; ``search_batch`` is the
+    same two halves back-to-back, so the paths cannot diverge."""
+
+    plans: list
+    results: list
+    waves: list
+    k_req: int
+    k2v: int
+    f2_nsel: int
+    bmax: int
+    topk: int
 
 
 class DeviceIndex:
@@ -665,9 +691,12 @@ class DeviceIndex:
                 "docc pack caps a shard at 2^28 docs — shard the corpus")
 
         # --- doc meta table (first posting per doc supplies siterank/
-        # langid — reference getSiteRank(miniMergedList[0]), 6989) ---
-        sr = np.zeros(self.D_cap, np.int32)
-        dl = np.zeros(self.D_cap, np.int32)
+        # langid — reference getSiteRank(miniMergedList[0]), 6989).
+        # uint8 columns: siterank is 4 bits and langid 6 in the posdb
+        # key itself, so the old int32 columns shipped 8× the bytes
+        # final_multipliers actually needs per doc ---
+        sr = np.zeros(self.D_cap, np.uint8)
+        dl = np.zeros(self.D_cap, np.uint8)
         if n:
             first = np.unique(docidx, return_index=True)[1]
             sr[docidx[first]] = siterank[first]
@@ -681,13 +710,13 @@ class DeviceIndex:
         dfs = np.diff(self.dir_dstart)
         tau = max(_env_int("OSSE_DENSE_MIN_DF", DENSE_MIN_DF),
                   self.D_cap // 64)
-        # 9 bytes per (term, doc) slot: f32 impact + int32 rs + u8 cnt.
+        # 7 bytes per (term, doc) slot: f16 impact + int32 rs + u8 cnt.
         # The slot count V power-of-two buckets (V is a kernel shape),
         # so the budget must hold for the BUCKETED V — at big D_cap a
         # raw-count budget bucketed up overshot HBM and the int32
         # scatter index space (measured at 250k docs: V 341→512)
         v_cap = 8
-        while (2 * v_cap * 9 * self.D_cap <= DENSE_BUDGET_BYTES
+        while (2 * v_cap * 7 * self.D_cap <= DENSE_BUDGET_BYTES
                and 2 * v_cap * self.D_cap < (1 << 31)):
             v_cap *= 2
         eligible = np.nonzero(dfs > tau)[0]
@@ -713,8 +742,8 @@ class DeviceIndex:
         mb_est = _bucket(max(len(doc_col), 1), COL_QUANTUM)
         n2_est = max(_bucket(max(nb_est // 4, min_delta, 1),
                              COL_QUANTUM), COL_QUANTUM)
-        cols_bytes = (nb_est + n2_est) * 8 + (mb_est + n2_est) * 13
-        dense_bytes = V * self.D_cap * 9
+        cols_bytes = (nb_est + n2_est) * 8 + (mb_est + n2_est) * 11
+        dense_bytes = V * self.D_cap * 7
         cube_bytes = min(
             CUBE_BUDGET_BYTES,
             max(1 << 30, HBM_USABLE_BYTES - cols_bytes - dense_bytes
@@ -758,7 +787,12 @@ class DeviceIndex:
                 | pocc.astype(np.uint32))
         self.d_docc = self._put(_pad_col(docc, self.Nb + self.N2))
         self.d_doc = self._put(_pad_col(doc_col, self.Mb + self.M2))
-        self.d_imp = self._put(_pad_col(imp_col, self.Mb + self.M2))
+        # packed resident impacts: the disk cache keeps exact f32 (the
+        # schema is unchanged); demotion to round-up f16 happens at
+        # device-put time so HBM holds half the impact bytes while the
+        # bounds stay admissible (demote_impacts docstring)
+        self.d_imp = self._put(_pad_col(demote_impacts(imp_col),
+                                        self.Mb + self.M2))
         self.d_rs = self._put(_pad_col(rs_col, self.Mb + self.M2))
         self.d_cnt = self._put(_pad_col(cnt_col, self.Mb + self.M2))
         dr_cum = np.r_[0, np.cumsum(dr_lens)].astype(np.int32)
@@ -902,8 +936,8 @@ class DeviceIndex:
             # doc-table updates from first delta posting per doc
             first = np.unique(docidx, return_index=True)[1]
             upd_idx = docidx[first].astype(np.int32)
-            upd_sr = fp_["siterank"][first].astype(np.int32)
-            upd_dl = fp_["langid"][first].astype(np.int32)
+            upd_sr = fp_["siterank"][first].astype(np.uint8)
+            upd_dl = fp_["langid"][first].astype(np.uint8)
             # donated in-place rewrites of the delta tails
             self.d_payload = _write_tail(
                 self.d_payload,
@@ -918,7 +952,8 @@ class DeviceIndex:
                 self.d_doc, self._put(_pad_col(doc2_col, self.M2)),
                 np.int32(self.Mb))
             self.d_imp = _write_tail(
-                self.d_imp, self._put(_pad_col(imp2, self.M2)),
+                self.d_imp,
+                self._put(_pad_col(demote_impacts(imp2), self.M2)),
                 np.int32(self.Mb))
             self.d_rs = _write_tail(
                 self.d_rs, self._put(_pad_col(rs2, self.M2)),
@@ -1328,7 +1363,24 @@ class DeviceIndex:
         """Batched execution: B queries per device round trip (vmap over
         the query axis). Routing: drivers with a bounded doc set use the
         two-phase pruned kernel (F1); corpus-wide drivers go to the
-        full-cube exact kernel (F2) when every sublist fits it."""
+        full-cube exact kernel (F2) when every sublist fits it.
+
+        One-shot form: issue + collect back-to-back. The resident
+        serving loop (query/resident.py) calls the two halves directly
+        so batch N+1 dispatches while wave N computes — same code
+        either way, so the paths cannot diverge."""
+        return self.collect_batch(self.issue_batch(
+            queries, topk=topk, lang=lang, df_of=df_of,
+            total_docs=total_docs, sort_base_of=sort_base_of))
+
+    def issue_batch(self, queries, topk: int = 64, lang: int = 0,
+                    df_of=None, total_docs: int | None = None,
+                    sort_base_of=None) -> PendingBatch:
+        """Plan + route + dispatch the first round of waves WITHOUT
+        fetching anything: every dispatch is async, so this returns as
+        soon as the host args are enqueued — no host sync. This is the
+        resident loop's steady-state dispatch cost (one enqueue), vs
+        the full jit round trip a one-shot ``search_batch`` pays."""
         from ..utils.stats import g_stats
         t_plan = time.perf_counter()
         qplans = [q if isinstance(q, QueryPlan) else compile_query(q, lang)
@@ -1362,7 +1414,9 @@ class DeviceIndex:
         results = [(np.empty(0, np.uint64), np.empty(0, np.float32), 0)
                    ] * len(plans)
         if not live:
-            return results
+            return PendingBatch(plans=plans, results=results, waves=[],
+                                k_req=0, k2v=0, f2_nsel=0, bmax=0,
+                                topk=topk)
         # corpus-relative routing: a driver matching more than ~1/8th of
         # the corpus (capped at the κ ladder's top rung) prunes badly —
         # full-cube scoring is cheaper than the escalation ladder. With
@@ -1427,83 +1481,103 @@ class DeviceIndex:
         f2_floor = 4096 if self.D_cap >= (1 << 19) else 2048
         f2_nsel = min(max(f2_floor, _bucket(k_req, 2048)), self.D_cap)
         bmax = self._f2_bmax()
-        while f1 or f2:
-            t_issue = time.perf_counter()
-            waves = []
-            groups: dict[tuple[int, int], list[int]] = {}
-            for i in f1:
-                kapi = self._kappa_of(plans[i], topk)
-                # phase-2 truncation to the top-k2 BY BOUND is only
-                # sound-in-practice for single-scored-group plans,
-                # where the bound ≈ the exact score; multi-group pair
-                # bounds are distance-free (up to ~400× loose), so
-                # bound order ≉ exact order and truncation would
-                # escalate nearly every query (measured 57%). Multi-
-                # group plans score every selected candidate.
-                if plans[i].n_scored <= 1:
-                    k2i = min(max(k2v, plans[i].k2_min), kapi)
-                else:
-                    k2i = kapi
-                groups.setdefault(
-                    (kapi, k2i, plans[i].has_table,
-                     plans[i].filters, plans[i].sortby), []).append(i)
-            for (kappa, k2g, *_spec), idxs in sorted(
-                    groups.items(), key=lambda kv: str(kv[0])):
-                # terminal rungs chunk small so the [T, P, k2]·B
-                # phase-2 intermediates stay bounded at k2 = D_cap
-                step = self._f1_bmax() if k2g <= 32 * KAPPA_FLOOR \
-                    else self._f1_step_terminal()
-                for a in range(0, len(idxs), step):
-                    chunk = idxs[a:a + step]
-                    waves.append(("f1", kappa, k2g, chunk,
-                                  self._run_batch(
-                                      [plans[i] for i in chunk],
-                                      kappa, k2g)))
-            fd = [i for i in f2 if plans[i].direct_ok]
-            fg = [i for i in f2 if not plans[i].direct_ok]
-            # group FD waves by scatter-tail size: the Lp lane bucket is
-            # per-wave, so one heavy-tailed query must not make every
-            # lane of its wave pay 16384-lane scatters
-            def _lp_of(i):
-                p = plans[i]
-                ml = int(p.p_len.max()) if len(p.p_len) else 0
-                if ml == 0:
-                    return 0  # pure quarter-row wave: no tail cube
-                return 512 if ml <= 512 else (
-                    F2_LPOST_FLOOR if ml <= F2_LPOST_FLOOR
-                    else F2_SCATTER_MAX)
-            # HARD-partition F2/FD waves by (Lp, filter/sort spec):
-            # the filter and sort columns are per-wave kernel args, so
-            # a chunk must never mix specs
-            spec_of = lambda i: (plans[i].filters, plans[i].sortby,
-                                 plans[i].has_table)
-            fd_parts: dict = {}
-            for i in fd:
-                fd_parts.setdefault((_lp_of(i), spec_of(i)),
-                                    []).append(i)
-            fd_step = self._fd_bmax()
-            for _, idxs in sorted(fd_parts.items(),
-                                  key=lambda kv: str(kv[0])):
-                for a in range(0, len(idxs), fd_step):
-                    chunk = idxs[a:a + fd_step]
-                    waves.append(("f2", 0, k2v, chunk,
-                                  self._run_batch_fd(
-                                      [plans[i] for i in chunk],
-                                      k2v, f2_nsel)))
-            fg_parts: dict = {}
-            for i in fg:
-                fg_parts.setdefault(spec_of(i), []).append(i)
-            for _, idxs in sorted(fg_parts.items(),
-                                  key=lambda kv: str(kv[0])):
-                for a in range(0, len(idxs), bmax):
-                    chunk = idxs[a:a + bmax]
-                    waves.append(("f2", 0, k2v, chunk,
-                                  self._run_batch_f2(
-                                      [plans[i] for i in chunk],
-                                      k2v, f2_nsel)))
-            g_stats.record_ms("devindex.issue",
-                              1000 * (time.perf_counter() - t_issue))
-            trace.record("devindex.issue", t_issue, waves=len(waves))
+        waves = self._issue_waves(plans, f1, f2, topk, k2v, f2_nsel,
+                                  bmax)
+        return PendingBatch(plans=plans, results=results, waves=waves,
+                            k_req=k_req, k2v=k2v, f2_nsel=f2_nsel,
+                            bmax=bmax, topk=topk)
+
+    def _issue_waves(self, plans, f1, f2, topk, k2v, f2_nsel, bmax):
+        """Build + dispatch one round of waves — all async enqueues;
+        the caller fetches every wave's output in ONE device_get."""
+        from ..utils.stats import g_stats
+        t_issue = time.perf_counter()
+        waves = []
+        groups: dict[tuple[int, int], list[int]] = {}
+        for i in f1:
+            kapi = self._kappa_of(plans[i], topk)
+            # phase-2 truncation to the top-k2 BY BOUND is only
+            # sound-in-practice for single-scored-group plans,
+            # where the bound ≈ the exact score; multi-group pair
+            # bounds are distance-free (up to ~400× loose), so
+            # bound order ≉ exact order and truncation would
+            # escalate nearly every query (measured 57%). Multi-
+            # group plans score every selected candidate.
+            if plans[i].n_scored <= 1:
+                k2i = min(max(k2v, plans[i].k2_min), kapi)
+            else:
+                k2i = kapi
+            groups.setdefault(
+                (kapi, k2i, plans[i].has_table,
+                 plans[i].filters, plans[i].sortby), []).append(i)
+        for (kappa, k2g, *_spec), idxs in sorted(
+                groups.items(), key=lambda kv: str(kv[0])):
+            # terminal rungs chunk small so the [T, P, k2]·B
+            # phase-2 intermediates stay bounded at k2 = D_cap
+            step = self._f1_bmax() if k2g <= 32 * KAPPA_FLOOR \
+                else self._f1_step_terminal()
+            for a in range(0, len(idxs), step):
+                chunk = idxs[a:a + step]
+                waves.append(("f1", kappa, k2g, chunk,
+                              self._run_batch(
+                                  [plans[i] for i in chunk],
+                                  kappa, k2g)))
+        fd = [i for i in f2 if plans[i].direct_ok]
+        fg = [i for i in f2 if not plans[i].direct_ok]
+        # group FD waves by scatter-tail size: the Lp lane bucket is
+        # per-wave, so one heavy-tailed query must not make every
+        # lane of its wave pay 16384-lane scatters
+        def _lp_of(i):
+            p = plans[i]
+            ml = int(p.p_len.max()) if len(p.p_len) else 0
+            if ml == 0:
+                return 0  # pure quarter-row wave: no tail cube
+            return 512 if ml <= 512 else (
+                F2_LPOST_FLOOR if ml <= F2_LPOST_FLOOR
+                else F2_SCATTER_MAX)
+        # HARD-partition F2/FD waves by (Lp, filter/sort spec):
+        # the filter and sort columns are per-wave kernel args, so
+        # a chunk must never mix specs
+        spec_of = lambda i: (plans[i].filters, plans[i].sortby,
+                             plans[i].has_table)
+        fd_parts: dict = {}
+        for i in fd:
+            fd_parts.setdefault((_lp_of(i), spec_of(i)),
+                                []).append(i)
+        fd_step = self._fd_bmax()
+        for _, idxs in sorted(fd_parts.items(),
+                              key=lambda kv: str(kv[0])):
+            for a in range(0, len(idxs), fd_step):
+                chunk = idxs[a:a + fd_step]
+                waves.append(("f2", 0, k2v, chunk,
+                              self._run_batch_fd(
+                                  [plans[i] for i in chunk],
+                                  k2v, f2_nsel)))
+        fg_parts: dict = {}
+        for i in fg:
+            fg_parts.setdefault(spec_of(i), []).append(i)
+        for _, idxs in sorted(fg_parts.items(),
+                              key=lambda kv: str(kv[0])):
+            for a in range(0, len(idxs), bmax):
+                chunk = idxs[a:a + bmax]
+                waves.append(("f2", 0, k2v, chunk,
+                              self._run_batch_f2(
+                                  [plans[i] for i in chunk],
+                                  k2v, f2_nsel)))
+        g_stats.record_ms("devindex.issue",
+                          1000 * (time.perf_counter() - t_issue))
+        trace.record("devindex.issue", t_issue, waves=len(waves))
+        return waves
+
+    def collect_batch(self, pending: PendingBatch):
+        """Fetch + parse every issued wave, re-issuing the (rare)
+        escalation rungs inline until all queries emit — the ONE
+        ``device_get`` per round is the only host sync on the path."""
+        from ..utils.stats import g_stats
+        plans, results = pending.plans, pending.results
+        waves, f2_nsel = pending.waves, pending.f2_nsel
+        k_req = pending.k_req
+        while waves:
             t_fetch = time.perf_counter()
             outs = jax.device_get([w[4] for w in waves])
             g_stats.record_ms(
@@ -1557,8 +1631,10 @@ class DeviceIndex:
                     self._emit(results, i, nm, idx, scores)
             if f1_next or f2_next:
                 self.escalations += len(f1_next) + len(f2_next)
-            f1, f2 = f1_next, f2_next
             f2_nsel = min(f2_nsel * 4, self.D_cap)
+            waves = self._issue_waves(
+                plans, f1_next, f2_next, pending.topk, pending.k2v,
+                f2_nsel, pending.bmax) if (f1_next or f2_next) else []
         return results
 
     def warm(self) -> int:
@@ -1621,6 +1697,17 @@ class DeviceIndex:
                     [dummy(ns=ns, nd=nd)] * nb, kap8, min(k2, kap8)))
                 outs.append(self._run_batch(
                     [dummy(ns=ns, nd=nd)] * nb, kap8, kap8))
+        # Lsp length buckets: the dummies above (s_len=1) warm the
+        # 128-lane tile; mid/long sparse runs hit the 512/2048-lane
+        # variants — warm those on the common shapes only
+        for lsp_len in LSP_BUCKETS[1:]:
+            for ns, nd in ((1, 1), (2, 1), (3, 3)):
+                pL = dummy(ns=ns, nd=nd)
+                pL.s_len[0] = lsp_len
+                for nb in ((1, 5) if b1 > 4 else (1,)):
+                    outs.append(self._run_batch(
+                        [pL] * nb, kap, min(k2, kap)))
+                    outs.append(self._run_batch([pL] * nb, kap, kap))
         # escalation rungs: (κ, k2) widen together, B=4 (few escapees)
         kap32 = min(KAPPA_FLOOR * 32, self.D_cap)
         outs.append(self._run_batch([dummy()], kap8,
@@ -1687,6 +1774,21 @@ class DeviceIndex:
                         [pl2] * nb, k2, min(n_sel, self.D_cap)))
         jax.device_get(outs)
         return len(outs)
+
+    def warm_plans(self) -> None:
+        """Build-time pre-warm of everything the FIRST query would
+        otherwise pay lazily (BENCH_r04: ``devindex.plan`` max 1168ms
+        vs 0.3ms min — the cold-plan spike). Host lazies (the docid
+        argsort + inverse permutation and the clusterdb sitehash/langid
+        columns) are a few ms and always primed; the kernel shape-grid
+        ``warm()`` is minutes of XLA compiles, so it runs off-CPU (or
+        under ``OSSE_WARM_KERNELS=1``) where those compiles would
+        otherwise land mid-serving."""
+        self._docid_pos(np.empty(0, np.uint64))
+        self._cluster_cols()
+        if jax.default_backend() != "cpu" or \
+                os.environ.get("OSSE_WARM_KERNELS"):
+            self.warm()
 
     def _parse_out(self, row, k2: int):
         nm = int(row[0])
@@ -1763,6 +1865,44 @@ class DeviceIndex:
             return max(4, min(16, (4 << 30) // max(per_q, 1)))
         return max(4, min(16, self._f2_bmax()))
 
+    def wave_bytes_per_query(self, plans: list[ResidentPlan],
+                             packed: bool = True) -> float:
+        """Modelled HBM bytes the F1 wave path streams per query —
+        under the live packed layout (f16 impacts, uint8 doc meta,
+        length-bucketed Lsp tiles) or the legacy unpacked one (f32
+        impacts, int32 meta, flat 2048-lane tiles). Shares _run_batch's
+        bucket ladders so the model moves when the layout does; the
+        per-plan Lsp tile is the fine-grained bound (real waves pay
+        their rung-group's max). BENCH_DISPATCH enforces packed/legacy
+        ≤ 0.7 on this model with a nonzero exit."""
+        imp = 2 if packed else 4
+        meta = 1 if packed else 4
+        V = self.d_dense_imp.shape[0]
+        D = self.D_cap
+        B = max(len(plans), 1)
+        total = 0.0
+        for p in plans:
+            mrs = max(len(p.s_start), 1)
+            Rs = 2 if mrs <= 2 else (4 if mrs <= 4 else (
+                16 if mrs <= 16 else _bucket(mrs, 64)))
+            mls = int(p.s_len.max()) if len(p.s_len) else 0
+            Lsp = next(b for b in LSP_BUCKETS if mls <= b) if packed \
+                else LSP_BUCKETS[-1]
+            mrd = max(len(p.d_slot), 1)
+            Rd = 2 if mrd <= 2 else (4 if mrd <= 4 else (
+                16 if mrd <= 16 else _bucket(mrd, 64)))
+            T = max(len(p.required), 1)
+            k2 = min(128, D)
+            # sparse lane gathers: doc4 + imp + rs4 + cnt1 + dead1
+            total += Rs * Lsp * (4 + imp + 4 + 1 + 1)
+            # doc-meta columns the multiplier/alive gates stream [D]
+            total += D * (meta + meta + 1)
+            # phase-2 payload + dense rs/cnt gathers (layout-invariant)
+            total += k2 * T * self.P * 4 + Rd * k2 * 5
+        # the [V, D] dense impact matrix streams once per WAVE
+        total += V * D * imp
+        return total / B
+
     def _run_batch(self, plans: list[ResidentPlan], kappa: int, k2: int):
         # pinned bucket ladders — every (Rd, Rs, κ, B) combination that
         # everyday queries can hit is finite and enumerable, so warm()
@@ -1775,7 +1915,13 @@ class DeviceIndex:
         mrs = max([len(p.s_start) for p in plans] + [1])
         Rs = 2 if mrs <= 2 else (4 if mrs <= 4 else (
             16 if mrs <= 16 else _bucket(mrs, 64)))
-        Lsp = LSP_FLOOR  # runs chunk at LSP_MAX == LSP_FLOOR (plan)
+        # length-bucketed lane tile: the wave pays for its LONGEST
+        # sparse run's bucket (runs chunk at LSP_MAX in the planner, so
+        # the top bucket always fits); short-list waves stop paying
+        # 2048-lane padding — most of their sparse HBM bytes
+        mls = max([int(p.s_len.max()) if len(p.s_len) else 0
+                   for p in plans] + [0])
+        Lsp = next(b for b in LSP_BUCKETS if mls <= b)
         T = max(len(p.required) for p in plans)
         # B buckets: every per-lane cost (phase-1 chains, phase-2
         # gathers) scales with B INCLUDING pad lanes, while the ~105 ms
@@ -2017,13 +2163,21 @@ def _two_phase(d_payload, d_doc, d_imp, d_rs, d_cnt,
     # [B·T, V] is a few-hot host-built matrix; the whole batch reads
     # the [V, D] impact matrix ONCE at bandwidth speed. The former
     # per-row dynamic slices cost ~91 ms/wave at B=32 (per-lane row
-    # copies); this is ~1 ms. HIGHEST precision keeps f32 exactness —
-    # the bound must never dip below the exact score (admissibility).
+    # copies); this is ~1 ms. The impact matrix is packed f16 at
+    # 1/IMPACT_SCALE (round-up demoted, so scaled-back values stay ≥
+    # the exact f32 impact); the selector's small integer counts are
+    # f16-exact, and the product accumulates in f32
+    # (preferred_element_type) — the bound stays admissible and the
+    # in-kernel ×1.00001 inflation covers the f32 accumulation
+    # reassociation as before. The exponent shift is undone exactly
+    # (power of two) on the f32 result.
     B, Ts, _ = d_sel.shape
     ubb_mm = jax.lax.dot_general(
-        d_sel.reshape(B * Ts, V), d_dense_imp,
+        d_sel.reshape(B * Ts, V).astype(d_dense_imp.dtype), d_dense_imp,
         (((1,), (0,)), ((), ())),
-        precision=jax.lax.Precision.HIGHEST).reshape(B, Ts, D)
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32).reshape(B, Ts, D) \
+        * jnp.float32(IMPACT_SCALE)
 
     def one(ubb, d_slot, d_group, d_base, d_quota, d_syn,
             s_start, s_len, s_group, s_base, s_quota, s_syn, s_isbase,
@@ -2047,7 +2201,11 @@ def _two_phase(d_payload, d_doc, d_imp, d_rs, d_cnt,
         smask = lane[None, :] < s_len[:, None]
         sidxc = jnp.clip(sidx, 0, M - 1)
         sdoc = d_doc[sidxc]
-        simp = d_imp[sidxc]
+        # gather moves the packed f16 bytes; the cast to f32 (and the
+        # exact IMPACT_SCALE shift back) happens in registers so the
+        # scatter-add target stays full precision
+        simp = d_imp[sidxc].astype(jnp.float32) * jnp.float32(
+            IMPACT_SCALE)
         srs = d_rs[sidxc]
         scnt = d_cnt[sidxc]
         sdead = d_dead[jnp.clip(sdoc, 0, D - 1)]              # [Rs, Lsp]

@@ -90,14 +90,24 @@ def build_results(get_doc, docids, scores, plan: QueryPlan, *,
                   topk: int, with_snippets: bool = True,
                   site_cluster: bool = True,
                   dedup_content: bool = True,
-                  site_of=None) -> tuple[list[Result], int]:
+                  site_of=None,
+                  page: tuple[int, int] | None = None
+                  ) -> tuple[list[Result], int]:
     """Msg40's post-merge stage: walk merged candidates best-first, fetch
     titlerecs from the owning store (Msg20/Msg22), apply content-hash
     dedup (Msg40's checksum dedup of identical pages) and site clustering
     (Msg51: at most MAX_PER_SITE per site, rest hidden), build summaries.
 
     ``get_doc`` is docid → titlerec dict (routes to the owning shard in
-    the mesh path). Returns (results, number hidden by cluster/dedup)."""
+    the mesh path). Returns (results, number hidden by cluster/dedup).
+
+    ``page`` = (offset, n): the rendered page window. When given (and
+    clusterdb columns back the clustering, ``site_of``), only ranks in
+    the PQR_SCAN rerank prefix or inside the page window fetch a
+    titlerec — rows in the gap between them exist solely to hold a rank
+    for deep paging, so they carry docid+score only. Content-hash dedup
+    needs the titlerec and is therefore skipped for gap rows (site
+    clustering is not: the sitehash column works without a fetch)."""
     from . import summary as summary_mod
 
     words = plan.match_words()
@@ -118,6 +128,18 @@ def build_results(get_doc, docids, scores, plan: QueryPlan, *,
             if sh and per_site.get(sh, 0) >= MAX_PER_SITE:
                 clustered += 1
                 continue
+        rank = len(results)
+        if (page is not None and site_of is not None
+                and rank >= PQR_SCAN
+                and not (page[0] <= rank < page[0] + page[1])):
+            # gap row: never reranked (rank ≥ PQR_SCAN), never rendered
+            # (outside the page) — skip the titledb fetch entirely
+            if site_cluster and site_of is not None:
+                sh = site_of(int(docid))
+                if sh:
+                    per_site[sh] = per_site.get(sh, 0) + 1
+            results.append(Result(docid=int(docid), score=float(score)))
+            continue
         rec = get_doc(int(docid))
         r = Result(docid=int(docid), score=float(score))
         if rec:
@@ -347,6 +369,10 @@ def get_device_index(coll: Collection):
             di = getattr(coll, "_device_index", None)
             if di is None:
                 di = DeviceIndex(coll)
+                # satellite of the resident-loop PR: pay the cold-plan
+                # spike (BENCH_r04: devindex.plan max 1168ms) at build
+                # time, not on the first user query
+                di.warm_plans()
                 coll._device_index = di
         return di
 
@@ -372,6 +398,8 @@ def get_device_index(coll: Collection):
         def _rebuild():
             try:
                 fresh = DeviceIndex(coll)
+                fresh.warm_plans()  # before the swap: first query on
+                # the fresh index must not re-pay the cold-plan spike
                 with lock:
                     coll._device_index = fresh
             except Exception:  # noqa: BLE001 — keep serving the old
@@ -386,41 +414,96 @@ def get_device_index(coll: Collection):
     return di
 
 
+def get_resident_loop(coll: Collection):
+    """The collection's ResidentLoop (query/resident.py), created
+    lazily like the device index itself. The loop owns issue/collect
+    sequencing; everything host-side (results building, snippets)
+    stays on the caller's thread."""
+    from .resident import ResidentLoop
+    loop = getattr(coll, "_resident_loop", None)
+    if loop is not None and loop.alive:
+        return loop
+    with _DI_CREATE_LOCK:
+        loop = getattr(coll, "_resident_loop", None)
+        if loop is None or not loop.alive:
+            loop = ResidentLoop(
+                lambda: get_device_index(coll),
+                gen_fn=lambda: coll.posdb.version,
+                name=getattr(coll, "name", "coll"))
+            coll._resident_loop = loop
+    return loop
+
+
 def search_device_batch(coll: Collection, queries, *, topk: int = 10,
                         lang: int = 0, with_snippets: bool = True,
-                        site_cluster: bool = True, offset: int = 0
+                        site_cluster: bool = True, offset: int = 0,
+                        resident: bool = False, results_lock=None
                         ) -> list[SearchResults]:
     """Batched resident-index search: B queries in one device round trip
-    (the TPU throughput mode — vmap over queries, SURVEY §7.8)."""
-    di = get_device_index(coll)
+    (the TPU throughput mode — vmap over queries, SURVEY §7.8).
+
+    ``resident=True`` routes the device work through the collection's
+    ResidentLoop: the dispatch is an enqueue onto a loop that is
+    already double-buffering waves, not a fresh issue→block round trip.
+    ``results_lock``, when given, is held ONLY around the host
+    post-processing (titledb reads mutate rdblite state) — never
+    around the device wait, so a server can overlap batch N's wave
+    with batch N-1's snippets."""
+    import contextlib
     plans = [q if isinstance(q, QueryPlan) else _compile_cached(q, lang)
              for q in queries]
     g_stats.count("query", len(plans))
-    with trace.timed_span("query.device_batch", queries=len(plans),
-                          topk=max((topk + offset) * 2, 64)):
-        raw = di.search_batch(plans, topk=max((topk + offset) * 2, 64),
-                              lang=lang)
+    ktot = max((topk + offset) * 2, 64)
+    if resident:
+        loop = get_resident_loop(coll)
+        with trace.timed_span("query.device_batch", queries=len(plans),
+                              topk=ktot, resident=True):
+            ticket = loop.submit(plans, topk=ktot, lang=lang)
+            raw = ticket.wait()
+        di = ticket.di  # the index the wave actually ran against
+    else:
+        di = get_device_index(coll)
+        with trace.timed_span("query.device_batch", queries=len(plans),
+                              topk=ktot):
+            raw = di.search_batch(plans, topk=ktot, lang=lang)
+
+    # one titlerec memo for the whole batch: build_results, PQR,
+    # page snippets and facets all re-read the same top docids
+    doc_memo: dict[int, dict | None] = {}
+
+    def get_doc(d: int):
+        d = int(d)
+        if d in doc_memo:
+            return doc_memo[d]
+        if len(doc_memo) >= 4096:
+            doc_memo.clear()
+        rec = docproc.get_document(coll, docid=d)
+        doc_memo[d] = rec
+        return rec
+
     out = []
     t_res = time.perf_counter()
-    for plan, (docids, scores, n_matched) in zip(plans, raw):
-        results, clustered = build_results(
-            lambda d: docproc.get_document(coll, docid=d),
-            docids, scores, plan, topk=max(topk + offset, PQR_SCAN),
-            with_snippets=False, site_cluster=site_cluster,
-            site_of=di.sitehash_of)
-        page = finish_page(
-            results, offset=offset, topk=topk, conf=coll.conf,
-            qlang=plan.lang, langid_of=di.langid_of,
-            get_doc=lambda d: docproc.get_document(coll, docid=d),
-            words=plan.match_words(),
-            with_snippets=with_snippets)
-        out.append(SearchResults(
-            query=plan.raw, total_matches=n_matched, results=page,
-            clustered=clustered,
-            suggestion=_suggest(coll, plan) if n_matched == 0 else None,
-            facets=compute_facets(
-                plan, docids,
-                lambda d: docproc.get_document(coll, docid=d))))
+    lock_ctx = results_lock if results_lock is not None \
+        else contextlib.nullcontext()
+    with lock_ctx:
+        for plan, (docids, scores, n_matched) in zip(plans, raw):
+            results, clustered = build_results(
+                get_doc,
+                docids, scores, plan, topk=max(topk + offset, PQR_SCAN),
+                with_snippets=False, site_cluster=site_cluster,
+                site_of=di.sitehash_of, page=(offset, topk))
+            page = finish_page(
+                results, offset=offset, topk=topk, conf=coll.conf,
+                qlang=plan.lang, langid_of=di.langid_of,
+                get_doc=get_doc,
+                words=plan.match_words(),
+                with_snippets=with_snippets)
+            out.append(SearchResults(
+                query=plan.raw, total_matches=n_matched, results=page,
+                clustered=clustered,
+                suggestion=_suggest(coll, plan)
+                if n_matched == 0 else None,
+                facets=compute_facets(plan, docids, get_doc)))
     g_stats.record_ms(
         "query.results_batch",
         1000 * (time.perf_counter() - t_res))

@@ -63,6 +63,38 @@ def pack_payload(f: dict[str, np.ndarray], syn: int = 0) -> np.ndarray:
     )
 
 
+#: packed impacts ride HBM scaled by 1/IMPACT_SCALE (an exact
+#: power-of-two exponent shift): inlink-heavy docs can push the raw
+#: bound past f16's 65504 max (BASE_SCORE·16²·MAX_TOP ≈ 2.6e5), and an
+#: inf in the dense matrix would turn the phase-1 selector matmul's
+#: 0-selector lanes into 0·inf = NaN, silently deleting docs from the
+#: intersection mask. Scaled, the ceiling is ~16k — comfortably inside
+#: range. Consumers multiply back after the f32 cast.
+IMPACT_SCALE = 16.0
+
+
+def demote_impacts(a: np.ndarray) -> np.ndarray:
+    """f32 per-(term, doc) impact bounds → float16 at 1/IMPACT_SCALE,
+    rounded UP.
+
+    The SURVEY §7 stage-8 packing move (Gigablast demoted full 18-byte
+    posdb keys to 12- and 6-byte forms by dropping shared prefixes; the
+    HBM analog demotes the rank-component columns to the narrowest type
+    the scorer math tolerates). Impacts are phase-1 UPPER BOUNDS, so
+    rounding must never go down (a bound below the exact score breaks
+    the lossless-pruning contract). The exponent shift is exact in
+    both directions (power of two), so admissibility is decided purely
+    by the cast: nearest-rounding casts that landed low are nudged up
+    one ulp. The 1e-30 presence floor would underflow f16 to 0.0 and
+    erase the posting from the intersection mask, so the floor re-lands
+    on the smallest f16 subnormal (exact in f32)."""
+    s = a * np.float32(1.0 / IMPACT_SCALE)
+    h = s.astype(np.float16)
+    low = h.astype(np.float32) < s
+    h = np.where(low, np.nextafter(h, np.float16(np.inf)), h)
+    return np.maximum(h, np.finfo(np.float16).smallest_subnormal)
+
+
 def _bucket(n: int, floor: int = 8) -> int:
     """Next power of two ≥ n (≥ floor) — static-shape jit buckets."""
     b = floor

@@ -21,6 +21,13 @@ every consumer tolerates (escalation tolerance 1e-4, bench recall
 floor 1e-6); the jnp path remains the reference semantics and the
 small-cube / CPU path. Validity rides the payloads: zero payload =
 empty slot (the build-side invariant the FD route already relies on).
+
+Packed-layout contract (SURVEY §7 stage-8): these kernels consume the
+uint32 payload cubes ONLY — never the f16 impact bounds or uint8
+siterank/langid columns the packed index demotes (those feed phase-1
+selection and the final multipliers, both outside this kernel). That
+is what makes the demotion score-exact: the exact rescore path through
+here reads bits the packing never touched.
 """
 
 from __future__ import annotations

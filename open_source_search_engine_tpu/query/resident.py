@@ -1,0 +1,249 @@
+"""Resident serving loop — double-buffered device dispatch.
+
+BENCH_r04 pinned single-query p50 at the ~117ms dispatch+fetch RTT:
+every ``search_batch`` call paid a full issue→block round trip even
+when the device was idle half the time. This loop breaks that floor
+the way the reference's UdpServer loop did for network I/O — ONE
+always-running consumer owns the device, callers only enqueue:
+
+* ``submit()`` appends to a queue and returns a :class:`Ticket`; it
+  never touches jax (no host↔device traffic on the caller's thread —
+  the osselint ``device-sync`` rule fences this file).
+* The loop thread issues wave N+1 (``DeviceIndex.issue_batch``: plan,
+  route, async dispatch — no fetch) while wave N is still computing,
+  then collects the oldest in-flight wave (``collect_batch``: the one
+  ``device_get`` + escalation reissues). Steady-state dispatch cost is
+  one async enqueue; the host sync overlaps the next wave's compute.
+* Depth is bounded at :data:`DEPTH` so a burst cannot pipeline
+  unbounded device memory.
+
+Freshness protocol (the generation rule the tests pin down): the loop
+re-resolves its DeviceIndex via ``di_fn`` ONLY while nothing is in
+flight. If ``gen_fn()`` (the Rdb version) moves while waves are in
+flight, those waves finish against the base they were issued on — but
+the loop drains them all BEFORE refreshing, so any ticket submitted
+after the write is guaranteed to be issued against a refreshed base
+(``Ticket.generation`` records which). Refreshing mid-flight would be
+worse than stale: ``refresh()`` donates the packed buffers a dispatched
+wave is still reading.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+from ..utils import threads as _threads
+from ..utils.log import get_logger
+from ..utils.stats import g_stats
+
+log = get_logger("resident")
+
+#: in-flight wave bound: issue N+1 while N computes (double-buffer);
+#: deeper pipelines buy nothing once the device is saturated and cost
+#: HBM for every staged wave
+DEPTH = 2
+
+#: brief collect window when the device is idle, letting concurrent
+#: submitters land in one wave (the QueryBatcher upstream coalesces
+#: HTTP waiters the same way)
+WINDOW_S = 0.0005
+
+
+class Ticket:
+    """One submit()'s handle: wait() blocks until the loop resolves it.
+
+    After resolution, ``di`` is the DeviceIndex the wave actually ran
+    against and ``generation`` its ``_built_version`` at issue time —
+    callers use ``di`` for post-processing (sitehash/langid lookups
+    must come from the same snapshot that scored)."""
+
+    __slots__ = ("plans", "topk", "lang", "di", "generation",
+                 "_ev", "_res", "_err")
+
+    def __init__(self, plans, topk: int, lang: int):
+        self.plans = plans
+        self.topk = topk
+        self.lang = lang
+        self.di = None
+        self.generation: int | None = None
+        self._ev = threading.Event()
+        self._res = None
+        self._err: BaseException | None = None
+
+    def _resolve(self, res) -> None:
+        self._res = res
+        self._ev.set()
+
+    def _fail(self, err: BaseException) -> None:
+        self._err = err
+        self._ev.set()
+
+    def wait(self, timeout: float = 120.0):
+        """Block for the wave's raw results ([(docids, scores, n)] per
+        plan). Raises the loop's error if the wave failed."""
+        if not self._ev.wait(timeout):
+            raise TimeoutError("resident loop ticket timed out")
+        if self._err is not None:
+            raise self._err
+        return self._res
+
+
+class _Wave:
+    """An issued-but-uncollected wave and the tickets riding it."""
+
+    __slots__ = ("pending", "tickets", "di")
+
+    def __init__(self, pending, tickets, di):
+        self.pending = pending
+        self.tickets = tickets
+        self.di = di
+
+
+class ResidentLoop:
+    """The per-collection dispatch loop (see module docstring).
+
+    ``di_fn`` resolves the current DeviceIndex (and refreshes it when
+    the Rdb moved — ``engine.get_device_index``); ``gen_fn`` reads the
+    live Rdb version so the loop can detect a mid-flight write without
+    touching the index."""
+
+    def __init__(self, di_fn: Callable[[], object],
+                 gen_fn: Callable[[], int],
+                 max_batch: int = 64, name: str = "coll"):
+        self._di_fn = di_fn
+        self._gen_fn = gen_fn
+        self._max_batch = max_batch
+        self._cv = threading.Condition()
+        self._queue: deque[Ticket] = deque()
+        self._inflight: deque[_Wave] = deque()
+        self._alive = True
+        self.waves_issued = 0
+        self.drains_for_freshness = 0
+        self._thread = _threads.spawn(f"resident-loop-{name}",
+                                      self._run)
+
+    @property
+    def alive(self) -> bool:
+        return self._alive and self._thread.is_alive()
+
+    def submit(self, plans, *, topk: int = 64, lang: int = 0) -> Ticket:
+        """Enqueue compiled plans; returns immediately. The hot path is
+        a list append + notify — no device work on this thread."""
+        t = Ticket(list(plans), topk, lang)
+        with self._cv:
+            if not self._alive:
+                t._fail(RuntimeError("resident loop stopped"))
+                return t
+            self._queue.append(t)
+            self._cv.notify_all()
+        return t
+
+    def stop(self) -> None:
+        """Kill the loop; queued and in-flight waiters fail fast."""
+        with self._cv:
+            self._alive = False
+            self._cv.notify_all()
+
+    # ------------------------------------------------------------- loop
+
+    def _run(self) -> None:
+        try:
+            while True:
+                with self._cv:
+                    while self._alive and not self._queue \
+                            and not self._inflight:
+                        self._cv.wait()
+                    if not self._alive:
+                        self._abort_locked(
+                            RuntimeError("resident loop stopped"))
+                        return
+                if not self._inflight and self._queue:
+                    # idle device: give concurrent submitters one brief
+                    # window to share the wave
+                    time.sleep(WINDOW_S)
+                if len(self._inflight) < DEPTH:
+                    self._issue_one()
+                if self._inflight and (
+                        len(self._inflight) >= DEPTH
+                        or not self._queue):
+                    self._collect_one()
+        except BaseException as exc:  # noqa: BLE001 — waiters must wake
+            log.exception("resident loop died")
+            with self._cv:
+                self._alive = False
+                self._abort_locked(exc)
+
+    def _abort_locked(self, exc: BaseException) -> None:
+        for t in self._queue:
+            t._fail(exc)
+        self._queue.clear()
+        for w in self._inflight:
+            for t in w.tickets:
+                t._fail(exc)
+        self._inflight.clear()
+
+    def _take_batch(self) -> list[Ticket]:
+        """Longest same-(topk, lang) PREFIX of the queue — prefix, not
+        filter, so resolution order is exactly submit order."""
+        with self._cv:
+            if not self._queue:
+                return []
+            head = self._queue[0]
+            batch, nplans = [], 0
+            while self._queue and len(batch) < self._max_batch:
+                t = self._queue[0]
+                if (t.topk, t.lang) != (head.topk, head.lang):
+                    break
+                if batch and nplans + len(t.plans) > self._max_batch:
+                    break
+                batch.append(self._queue.popleft())
+                nplans += len(t.plans)
+            return batch
+
+    def _index_for_issue(self):
+        """The freshness protocol (module docstring): never re-resolve
+        the index while waves are in flight — drain first if the Rdb
+        moved, else keep issuing against the in-flight snapshot."""
+        if self._inflight:
+            di = self._inflight[-1].di
+            if self._gen_fn() != di._built_version:
+                self.drains_for_freshness += 1
+                while self._inflight:
+                    self._collect_one()
+                return self._di_fn()
+            return di
+        return self._di_fn()
+
+    def _issue_one(self) -> None:
+        batch = self._take_batch()
+        if not batch:
+            return
+        try:
+            di = self._index_for_issue()
+            plans = [p for t in batch for p in t.plans]
+            pending = di.issue_batch(plans, topk=batch[0].topk,
+                                     lang=batch[0].lang)
+            for t in batch:
+                t.di = di
+                t.generation = di._built_version
+            self._inflight.append(_Wave(pending, batch, di))
+            self.waves_issued += 1
+            g_stats.count("resident.issue")
+        except BaseException as exc:  # noqa: BLE001
+            for t in batch:
+                t._fail(exc)
+
+    def _collect_one(self) -> None:
+        wave = self._inflight.popleft()
+        try:
+            results = wave.di.collect_batch(wave.pending)
+            off = 0
+            for t in wave.tickets:
+                t._resolve(results[off:off + len(t.plans)])
+                off += len(t.plans)
+        except BaseException as exc:  # noqa: BLE001
+            for t in wave.tickets:
+                t._fail(exc)

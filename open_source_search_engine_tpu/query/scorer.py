@@ -243,7 +243,12 @@ def min_scores(cube, pvalid, freq_weight, single_counts):
 
 
 def final_multipliers(siterank, doclang, qlang):
-    """Siterank/language multipliers (Posdb.cpp:7250-7257), [D]."""
+    """Siterank/language multipliers (Posdb.cpp:7250-7257), [D].
+
+    Dtype contract: ``siterank``/``doclang`` may arrive as the packed
+    uint8 resident columns (siterank is 4 bits, langid 6 in the posdb
+    key) — everything here promotes/casts, so callers ship the narrow
+    columns and no f32 copy ever lives in HBM."""
     lang_mult = jnp.where(
         (qlang == 0) | (doclang == 0) | (doclang == qlang),
         weights.SAME_LANG_WEIGHT, 1.0)

@@ -337,10 +337,17 @@ class SearchHTTPServer:
 
     def _run_device_batch(self, key: tuple, queries: list[str]):
         cname, topk, offset = key
+        # resident-loop dispatch: the device wave runs OUTSIDE the core
+        # lock (the ResidentLoop serializes issue/collect itself), so a
+        # wave in flight no longer blocks injects or the next batch.
+        # The lock still covers the collection lookup and — via
+        # results_lock — the host post-processing, which reads the
+        # single-writer Rdb/titledb structures.
         with self._lock:
-            return engine.search_device_batch(
-                self.colldb.get(cname), queries, topk=topk,
-                offset=offset)
+            coll = self.colldb.get(cname)
+        return engine.search_device_batch(
+            coll, queries, topk=topk, offset=offset,
+            resident=True, results_lock=self._lock)
 
     def _authorized(self, query: dict,
                     min_role: str = "admin") -> bool:
@@ -1203,6 +1210,12 @@ class SearchHTTPServer:
     def stop(self) -> None:
         self._stop_sampling.set()
         self._batcher.stop()
+        # stop per-collection resident loops with the batcher that fed
+        # them (engine.get_resident_loop lazily respawns on restart)
+        for cn in self.colldb.names():
+            loop = getattr(self.colldb.get(cn), "_resident_loop", None)
+            if loop is not None:
+                loop.stop()
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()

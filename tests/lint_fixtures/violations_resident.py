@@ -1,0 +1,21 @@
+# osselint: path=open_source_search_engine_tpu/query/resident.py
+# osselint fixture — the pragma re-scopes this file to the resident
+# serving loop, where the device-sync rule's EXTENDED fence applies:
+# the enqueue path may neither sync the host (device_get /
+# block_until_ready) nor stage device buffers (device_put / asarray —
+# issue_batch in devindex.py owns host→device transfers). Never
+# scanned by the real linter (lint_fixtures/ is excluded from walks).
+import jax
+import jax.numpy as jnp
+
+
+def submit_bad(queue, arrs):
+    staged = jax.device_put(arrs)  # EXPECT device-sync
+    lane = jnp.asarray(arrs)  # EXPECT device-sync
+    queue.append((staged, lane))
+
+
+def collect_bad(wave):
+    out = jax.device_get(wave)  # EXPECT device-sync
+    wave.block_until_ready()  # EXPECT device-sync
+    return out

@@ -71,6 +71,21 @@ class TestFixtures:
         covered = set(re.findall(r"# EXPECT ([a-z\-]+)", src))
         assert covered == osselint.RULE_NAMES
 
+    def test_resident_fence_fixture_matches_markers(self):
+        """The resident-loop fixture pins the device-sync rule's
+        extended fence (device_put/asarray banned alongside the sync
+        calls) to exact lines."""
+        src = (FIXTURES / "violations_resident.py").read_text()
+        expected = set()
+        for i, line in enumerate(src.splitlines(), start=1):
+            for rule in re.findall(r"# EXPECT ([a-z\-]+)", line):
+                expected.add((i, rule))
+        got = {(f.line, f.rule) for f in
+               _lint_file(FIXTURES / "violations_resident.py")}
+        assert got == expected, (
+            f"missed: {sorted(expected - got)}\n"
+            f"spurious: {sorted(got - expected)}")
+
     def test_clean_fixture_has_no_findings(self):
         findings = _lint_file(FIXTURES / "clean_parallel.py")
         assert not findings, [(f.line, f.rule) for f in findings]
@@ -222,3 +237,17 @@ class TestRuleMechanics:
             src, "open_source_search_engine_tpu/query/engine.py")
         assert "syntax-error" not in {f.rule for f in found}
         assert [f.rule for f in found] == ["device-sync"]
+
+    def test_device_staging_fenced_only_in_resident_loop(self):
+        """device_put/asarray are legal almost everywhere — the
+        extended fence applies to query/resident.py alone (its submit
+        path must be a pure enqueue)."""
+        src = "import jax\nv = jax.device_put(x)\n"
+        found = osselint.check_source(
+            src, "open_source_search_engine_tpu/query/resident.py")
+        assert [f.rule for f in found] == ["device-sync"]
+        assert osselint.check_source(
+            src, "open_source_search_engine_tpu/query/engine.py") == []
+        assert osselint.check_source(
+            src,
+            "open_source_search_engine_tpu/query/devindex.py") == []

@@ -1,0 +1,209 @@
+"""Resident serving loop — ordering, freshness, and one-shot parity.
+
+The loop's contract (query/resident.py): submit() is a pure enqueue;
+results come back for exactly the plans submitted, in submit order; a
+write landing while waves are in flight drains those waves against
+their issue-time base and every LATER submit is issued against a
+refreshed index (Ticket.generation proves which base scored it).
+"""
+
+import threading
+
+import pytest
+
+from open_source_search_engine_tpu.build import docproc
+from open_source_search_engine_tpu.index.collection import Collection
+from open_source_search_engine_tpu.query import engine
+from open_source_search_engine_tpu.query.engine import (
+    _compile_cached, get_device_index, get_resident_loop,
+    search_device_batch)
+from open_source_search_engine_tpu.query.resident import ResidentLoop
+
+DOCS = {
+    "http://a.example.com/fruit": """
+      <html><head><title>Fruit basics</title></head><body>
+      <p>The apple is sweet. A banana is tropical. Apple pie wins.</p>
+      </body></html>""",
+    "http://b.example.com/apple": """
+      <html><head><title>Apple orchard</title></head><body>
+      <p>Our orchard grows apple trees. Apple harvest is in fall.</p>
+      </body></html>""",
+    "http://c.example.org/banana": """
+      <html><head><title>Banana farm</title></head><body>
+      <p>Banana plantations export banana bunches worldwide.</p>
+      </body></html>""",
+    "http://d.example.org/cellar": """
+      <html><head><title>Vegetables</title></head><body>
+      <p>Carrots and beets. Root cellar storage tips.</p></body></html>""",
+}
+
+QUERIES = ["apple", "banana", "apple banana", "fruit", "cellar",
+           "orchard apple", "zeppelin"]
+
+
+@pytest.fixture()
+def coll(tmp_path):
+    c = Collection("res", tmp_path)
+    c.conf.pqr_enabled = False
+    for u, h in DOCS.items():
+        docproc.index_document(c, u, h)
+    return c
+
+
+def _key(r):
+    return (-round(r.score, 3), r.docid)
+
+
+class TestParity:
+    def test_resident_matches_one_shot_batch(self, coll):
+        """CPU parity: the loop's issue/collect split must reproduce
+        one-shot search_device_batch exactly (same plans, same index
+        snapshot → same docids and scores)."""
+        one_shot = search_device_batch(coll, QUERIES, topk=10,
+                                       site_cluster=False)
+        res = search_device_batch(coll, QUERIES, topk=10,
+                                  site_cluster=False, resident=True)
+        for q, a, b in zip(QUERIES, one_shot, res):
+            assert b.total_matches == a.total_matches, q
+            assert sorted(map(_key, b.results)) == \
+                   sorted(map(_key, a.results)), q
+
+    def test_raw_ticket_matches_search_batch(self, coll):
+        di = get_device_index(coll)
+        plans = [_compile_cached(q, 0) for q in QUERIES]
+        ref = di.search_batch(plans, topk=64, lang=0)
+        loop = get_resident_loop(coll)
+        got = loop.submit(plans, topk=64, lang=0).wait()
+        assert len(got) == len(ref)
+        for q, (rd, rs, rn), (gd, gs, gn) in zip(QUERIES, ref, got):
+            assert gn == rn, q
+            assert list(gs) == list(rs), q
+
+
+class TestOrdering:
+    def test_concurrent_submits_get_their_own_results(self, coll):
+        """16 threads × 4 rounds enqueue distinct queries concurrently;
+        every ticket must resolve to ITS query's results (no swaps, no
+        cross-wave mixups), matching a one-shot reference."""
+        di = get_device_index(coll)
+        ref = {}
+        for q in QUERIES:
+            plan = _compile_cached(q, 0)
+            ((d, s, n),) = di.search_batch([plan], topk=64, lang=0)
+            ref[q] = (sorted(d.tolist()), n)
+        loop = get_resident_loop(coll)
+        errors = []
+        start = threading.Barrier(16)
+
+        def worker(i):
+            try:
+                start.wait(timeout=30)
+                for r in range(4):
+                    q = QUERIES[(i + r) % len(QUERIES)]
+                    t = loop.submit([_compile_cached(q, 0)],
+                                    topk=64, lang=0)
+                    ((d, s, n),) = t.wait(timeout=60)
+                    assert (sorted(d.tolist()), n) == ref[q], q
+            except BaseException as exc:  # noqa: BLE001
+                errors.append((i, exc))
+
+        ts = [threading.Thread(target=worker, args=(i,), daemon=True)
+              for i in range(16)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert not errors, errors
+        assert loop.waves_issued >= 1
+
+    def test_one_submit_many_plans_keeps_plan_order(self, coll):
+        loop = get_resident_loop(coll)
+        plans = [_compile_cached(q, 0) for q in QUERIES]
+        got = loop.submit(plans, topk=64, lang=0).wait()
+        di = get_device_index(coll)
+        ref = di.search_batch(plans, topk=64, lang=0)
+        for (rd, rs, rn), (gd, gs, gn) in zip(ref, got):
+            assert gn == rn and list(gs) == list(rs)
+
+
+class TestFreshness:
+    def test_write_bumps_generation_and_serves_fresh(self, coll):
+        """A submit after a write must be issued against a refreshed
+        base: the new doc is visible and Ticket.generation moved past
+        the pre-write generation — the loop never reuses the pre-write
+        packed base for post-write tickets."""
+        loop = get_resident_loop(coll)
+        t0 = loop.submit([_compile_cached("apple", 0)], topk=64, lang=0)
+        t0.wait(timeout=60)
+        gen0 = t0.generation
+        assert gen0 == t0.di._built_version
+
+        docproc.index_document(
+            coll, "http://e.example.com/durian",
+            "<html><title>Durian</title><body>"
+            "<p>The durian fruit is pungent.</p></body></html>")
+        assert coll.posdb.version != gen0  # the write moved the Rdb
+
+        t1 = loop.submit([_compile_cached("durian", 0)], topk=64,
+                         lang=0)
+        ((docids, scores, n),) = t1.wait(timeout=60)
+        assert n >= 1 and len(docids) >= 1  # fresh doc is searchable
+        assert t1.generation != gen0
+        assert t1.generation == t1.di._built_version
+
+    def test_midflight_write_drains_before_refresh(self, coll):
+        """Drive the loop's freshness branch directly: with a wave in
+        flight, a generation move forces a drain of the old-base waves
+        before any new issue — the in-flight ticket keeps its issue
+        generation, the post-write ticket gets the new one."""
+        di = get_device_index(coll)
+        gens = [di._built_version]
+
+        def di_fn():
+            return get_device_index(coll)
+
+        def gen_fn():
+            return coll.posdb.version
+
+        loop = ResidentLoop(di_fn, gen_fn, name="midflight")
+        try:
+            plan = _compile_cached("banana", 0)
+            first = loop.submit([plan], topk=64, lang=0)
+            first.wait(timeout=60)
+            docproc.index_document(
+                coll, "http://f.example.com/mango",
+                "<html><title>Mango</title><body>"
+                "<p>Mango season, mango juice.</p></body></html>")
+            # burst of submits racing the version bump: every ticket
+            # must still score consistently with ITS recorded base
+            tickets = [loop.submit([_compile_cached("mango", 0)],
+                                   topk=64, lang=0) for _ in range(6)]
+            for t in tickets:
+                t.wait(timeout=60)
+            # the last ticket was certainly issued post-write (the
+            # submits happened after index_document returned)
+            last = tickets[-1]
+            assert last.generation == coll.posdb.version
+            ((d, s, n),) = last.wait()
+            assert n >= 1
+            assert gens[0] != last.generation
+        finally:
+            loop.stop()
+
+
+class TestLifecycle:
+    def test_stop_fails_fast_and_loop_respawns(self, coll):
+        loop = get_resident_loop(coll)
+        loop.submit([_compile_cached("apple", 0)], topk=64,
+                    lang=0).wait(timeout=60)
+        loop.stop()
+        t = loop.submit([_compile_cached("apple", 0)], topk=64, lang=0)
+        with pytest.raises(RuntimeError):
+            t.wait(timeout=10)
+        # engine hands out a fresh loop once the old one is dead
+        loop2 = get_resident_loop(coll)
+        assert loop2 is not loop and loop2.alive
+        ((d, s, n),) = loop2.submit(
+            [_compile_cached("apple", 0)], topk=64, lang=0
+        ).wait(timeout=60)
+        assert n >= 1

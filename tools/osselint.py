@@ -428,17 +428,30 @@ def _locked_global_scope(rel: str) -> bool:
 
 
 def rule_device_sync(ctx: Ctx) -> list[Finding]:
+    # the resident serving loop is the one file where even ASYNC
+    # host→device traffic is banned: submit() runs on request threads
+    # and the loop's contract is "enqueue only" — staging transfers
+    # belong in devindex.py's issue path
+    resident = ctx.rel == f"{PKG}/query/resident.py"
     out = []
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call):
             continue
         name = dotted(node.func)
+        tail = name.split(".")[-1] if name else ""
         hit = None
-        if name and name.split(".")[-1] == "device_get":
+        if tail == "device_get":
             hit = "device_get"
         elif isinstance(node.func, ast.Attribute) \
                 and node.func.attr == "block_until_ready":
             hit = "block_until_ready"
+        elif resident and tail in ("device_put", "asarray"):
+            out.append(Finding(
+                ctx.rel, node.lineno, "device-sync",
+                f"{tail} in the resident loop — the enqueue path must "
+                "not stage device buffers; issue_batch in "
+                "query/devindex.py owns host→device transfers"))
+            continue
         if hit:
             out.append(Finding(
                 ctx.rel, node.lineno, "device-sync",
