@@ -549,6 +549,89 @@ def main_dispatch() -> None:
         sys.exit(1)
 
 
+def main_jit() -> None:
+    """Trace-discipline gate (BENCH_JIT=1): 64 steady-state resident
+    waves after warmup, under the jit watcher. The PR 6 headline —
+    steady-state dispatch is one async enqueue — is only true while
+    nothing recompiles and nothing syncs to host off the boundary, so
+    this exits 1 if the watcher attributes ANY compile, retrace, or
+    off-boundary transfer to the measured waves (the one blessed
+    ``device_get`` per wave in devindex.collect_batch is on-boundary
+    and allowed). The attribution table goes into the bench JSON so a
+    breach names its call site.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from collections import deque
+
+    from open_source_search_engine_tpu.build import docproc
+    from open_source_search_engine_tpu.index.collection import Collection
+    from open_source_search_engine_tpu.query import engine
+    from open_source_search_engine_tpu.query.engine import (
+        get_device_index, get_resident_loop)
+    from open_source_search_engine_tpu.utils import jitwatch
+
+    bdir = tempfile.mkdtemp(prefix="osse_bench_jit_")
+    coll = Collection("jitbench", bdir)
+    docproc.index_batch(coll, [
+        (f"http://bench.test/d{d}",
+         f"<html><body><p>dispatch bench words filler token{d % 37} "
+         f"extra{d % 11} rare{d % 101}</p></body></html>")
+        for d in range(int(os.environ.get("BENCH_JIT_DOCS", "240")))])
+    get_device_index(coll)
+    # same zipf-ish mix as BENCH_DISPATCH: head/mid/tail terms, varied
+    # term counts so several shape buckets are live
+    qs = [f"bench token{k % 37}" if k % 3 else f"words rare{k % 101}"
+          for k in range(24)]
+    qs += [f"filler extra{k % 11} token{k % 37}" for k in range(8)]
+    plans = [engine._compile_cached(q, 0) for q in qs]
+
+    jitwatch.enable()
+    loop = get_resident_loop(coll)
+    # warmup: every plan once — compiles every live shape bucket, and
+    # is excluded from the gate
+    for p in plans:
+        loop.submit([p], topk=64).wait(timeout=120)
+
+    jitwatch.reset()
+    n_waves = int(os.environ.get("BENCH_JIT_WAVES", "64"))
+    lats: list[float] = []
+    inflight: deque = deque()
+    for k in range(n_waves):
+        inflight.append((loop.submit([plans[k % len(plans)]], topk=64),
+                         time.perf_counter()))
+        while len(inflight) >= 2:  # depth-2 steady state
+            tk, t0 = inflight.popleft()
+            tk.wait(timeout=120)
+            lats.append(time.perf_counter() - t0)
+    while inflight:
+        tk, t0 = inflight.popleft()
+        tk.wait(timeout=120)
+        lats.append(time.perf_counter() - t0)
+
+    snap = jitwatch.snapshot()
+    t = snap["totals"]
+    offb = [e for e in snap["events"]
+            if e["kind"] == "transfer" and not e["boundary"]]
+    ok = (t["compiles"] == 0 and t["retraces"] == 0
+          and t["transfers_offboundary"] == 0)
+    lats.sort()
+    print(json.dumps({
+        "metric": "jit_steady_state_compiles",
+        "value": t["compiles"], "unit": "compiles",
+        "waves": n_waves,
+        "p50_ms": round(1000 * lats[len(lats) // 2], 2),
+        "retraces": t["retraces"],
+        "transfers": t["transfers"],
+        "transfers_offboundary": t["transfers_offboundary"],
+        "offboundary_sites": [e["site"] for e in offb],
+        "attribution": snap["events"],
+        "ok": ok,
+        "budget": "zero compiles/retraces/off-boundary transfers",
+    }))
+    if not ok:
+        sys.exit(1)
+
+
 def main() -> None:
     try:
         jax = _init_backend()
@@ -834,5 +917,7 @@ if __name__ == "__main__":
         main_trace()
     elif os.environ.get("BENCH_DISPATCH"):
         main_dispatch()
+    elif os.environ.get("BENCH_JIT"):
+        main_jit()
     else:
         main()

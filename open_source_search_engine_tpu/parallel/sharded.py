@@ -43,7 +43,8 @@ from ..query.compiler import QueryPlan, compile_query
 from ..query.engine import SearchResults, build_results
 from ..query.packer import (MAX_POSITIONS, PackedQuery, PreparedQuery,
                             pad_table,
-                            _pad1, group_flags, pack_pass, prepare_query)
+                            _bucket, _pad1, group_flags, pack_pass,
+                            prepare_query)
 from ..query.scorer import score_core
 from ..utils.log import get_logger
 from ..utils.membudget import g_membudget
@@ -560,7 +561,11 @@ def sharded_search(sc: ShardedCollection, q: str | QueryPlan, *,
     D = max(len(p.siterank) for p in live)
     packs = [_pad_packed(p, T, L, D, plan, freqw) for p in packs]
 
-    k = min(max(topk + offset, 64), D)
+    # local_k rides the power-of-two bucket ladder: topk+offset is
+    # request-controlled, and _sharded_score takes it as a STATIC, so
+    # an unbucketed value would mint one shard_map compile per page
+    # size (the Msg39 retrace cliff the jit-unstable-static lint bans)
+    k = min(_bucket(max(topk + offset, 64), 64), D)
     stack = lambda f: np.stack([f(p) for p in packs])
     args = dict(
         doc_idx=stack(lambda p: p.doc_idx),
@@ -598,7 +603,9 @@ def sharded_search(sc: ShardedCollection, q: str | QueryPlan, *,
     out_k = max(want, 64)
     max_out = sc.n_shards * k
     while True:
-        kk = min(out_k, max_out)
+        # out_k is static too — bucket it so the escalation ladder
+        # (×4 per round) revisits the same compiled programs
+        kk = min(_bucket(out_k, 64), max_out)
         out = np.asarray(_sharded_score(
             mesh, sharded_args["doc_idx"], sharded_args["payload"],
             sharded_args["slot"], sharded_args["valid"],

@@ -99,7 +99,7 @@ from ..index import clusterdb as clusterdb_mod
 from ..index import posdb
 from ..index.collection import Collection
 from ..index.rdblite import merge_batches
-from ..utils import trace
+from ..utils import jitwatch, trace
 from ..utils.log import get_logger
 from . import devcheck, weights
 from .compiler import SUB_SYNONYM, QueryPlan, compile_query
@@ -109,6 +109,11 @@ from .packer import (IMPACT_SCALE, MAX_POSITIONS, T_FLOOR, TABLE_SIZE,
 from .scorer import final_multipliers, min_scores, presence_table_ok
 
 log = get_logger("devindex")
+
+# the device layer is the first import on every jit path — turning the
+# watcher on here means OSSE_JITWATCH=1 covers tests, bench, and serve
+# without each entry point opting in
+jitwatch.maybe_enable()
 
 #: shape-bucket floors (distinct shape tuples = one XLA compile each)
 RD_FLOOR = 4      # dense rows

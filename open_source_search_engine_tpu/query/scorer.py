@@ -53,7 +53,7 @@ import numpy as np
 from ..index.posdb import HASHGROUP_END, HASHGROUP_INLINKTEXT
 from ..utils import trace
 from . import weights
-from .packer import MAX_POSITIONS, TABLE_SIZE, PackedQuery
+from .packer import MAX_POSITIONS, TABLE_SIZE, PackedQuery, _bucket
 
 QDIST = 2.0  # default query-distance (Posdb.cpp:6886)
 
@@ -350,6 +350,11 @@ _score_packed = jax.jit(_score_packed_out,
 def run_query(pq: PackedQuery, topk: int = 64):
     """Host wrapper: PackedQuery → (docids, scores, total matched)."""
     k = min(topk, len(pq.siterank))
+    # the static top-k rides the power-of-two bucket ladder: engine
+    # passes max(topk+offset, 64) straight from the request, and an
+    # unbucketed static is one fresh compile per distinct page size;
+    # top_k sorts descending, so slicing the first k of kb is exact
+    kb = min(_bucket(max(topk, 1), 64), len(pq.siterank))
     # one batched device_put: per-arg implicit transfers each pay the
     # tunnel RPC overhead; a single list transfer is ~10× cheaper
     dpad = len(pq.siterank)
@@ -363,7 +368,7 @@ def run_query(pq: PackedQuery, topk: int = 64):
     t_dev = time.perf_counter()
     dev = jax.device_put(up)
     out = np.asarray(_score_packed(
-        *dev, n_positions=MAX_POSITIONS, topk=topk,
+        *dev, n_positions=MAX_POSITIONS, topk=kb,
         use_filter=pq.use_filter, use_sort=pq.use_sort))
     # np.asarray blocks on the result — this delta is transfer + kernel
     # (device time); bytes_up/bytes_down are the wire both ways
@@ -371,8 +376,8 @@ def run_query(pq: PackedQuery, topk: int = 64):
                  bytes_up=int(sum(np.asarray(a).nbytes for a in up)),
                  bytes_down=int(out.nbytes))
     n_matched = int(out[0])
-    top_idx = out[1:1 + k].astype(np.int64)
-    top_scores = out[1 + k:].view(np.float32)
+    top_idx = out[1:1 + kb][:k].astype(np.int64)
+    top_scores = out[1 + kb:].view(np.float32)[:k]
     keep = top_scores > 0.0
     idx = top_idx[keep]
     return pq.cand_docids[idx], top_scores[keep], n_matched

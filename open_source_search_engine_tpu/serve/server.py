@@ -488,6 +488,8 @@ class SearchHTTPServer:
             return self._page_traces(query)
         if path == "/admin/parms":
             return self._page_parms(query)
+        if path == "/admin/jit":
+            return self._page_jit(query)
         return 404, json.dumps({"error": "no such page"}), \
             "application/json"
 
@@ -789,7 +791,8 @@ class SearchHTTPServer:
         links = "".join(
             f'<li><a href="/admin/{p}{sfx}">{p}</a></li>'
             for p in ("stats", "hosts", "perf", "mem", "transport",
-                      "cache", "traces", "parms", "profiler", "graph"))
+                      "cache", "traces", "parms", "jit", "profiler",
+                      "graph"))
         rows = "".join(f"<tr><td>{k}</td><td>{v}</td></tr>"
                        for k, v in self.stats.items())
         colls = ", ".join(self.colldb.names())
@@ -906,6 +909,43 @@ class SearchHTTPServer:
             "<th>KB</th><th>hits</th><th>misses</th><th>hit rate</th>"
             "<th>evict</th><th>stale</th><th>generation</th>"
             f"<th>enabled</th><th></th></tr>{rows}</table>"
+            "</body></html>"), "text/html"
+
+    def _page_jit(self, query: dict) -> tuple[int, str, str]:
+        """Compile/retrace/transfer attribution from the jit watcher
+        (OSSE_JITWATCH=1): every event keyed by (function,
+        shape-signature, call-site), so a steady-state retrace or a
+        hidden host sync names its line. ``?format=json`` returns the
+        raw snapshot."""
+        from ..utils import jitwatch
+        from ..utils.stats import g_stats
+        snap = jitwatch.snapshot()
+        counters = g_stats.snapshot()["counters"]
+        snap["counters"] = {k: v for k, v in sorted(counters.items())
+                            if k.startswith("jit.")}
+        if query.get("format") == "json":
+            return 200, json.dumps(snap), "application/json"
+        t = snap["totals"]
+        rows = "".join(
+            f"<tr><td>{e['kind']}</td><td>{e['fn']}</td>"
+            f"<td>{e['site']}</td><td>{e['count']}</td>"
+            f"<td>{e['bytes']}</td>"
+            f"<td>{'yes' if e['boundary'] else 'NO'}</td>"
+            f"<td>{e['shapes'] or e['last']}</td></tr>"
+            for e in snap["events"]) \
+            or "<tr><td colspan=7>none</td></tr>"
+        return 200, (
+            "<html><head><title>gb jit</title></head><body>"
+            "<h1>jit plane</h1>"
+            f"<p>watcher {'enabled' if snap['enabled'] else 'DISABLED'}"
+            f" &middot; compiles {t['compiles']}"
+            f" &middot; first traces {t['first_traces']}"
+            f" &middot; retraces {t['retraces']}"
+            f" &middot; transfers {t['transfers']}"
+            f" (off-boundary {t['transfers_offboundary']})</p>"
+            "<table border=1><tr><th>kind</th><th>fn</th><th>site</th>"
+            "<th>count</th><th>bytes</th><th>boundary</th>"
+            f"<th>detail</th></tr>{rows}</table>"
             "</body></html>"), "text/html"
 
     #: waterfall bar palette — one color per host, assigned by hash so
@@ -1148,6 +1188,8 @@ class SearchHTTPServer:
     # --- lifecycle --------------------------------------------------------
 
     def start(self) -> None:
+        from ..utils import jitwatch
+        jitwatch.maybe_enable()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):

@@ -1,0 +1,38 @@
+# osselint: path=open_source_search_engine_tpu/query/fixture_jit_ok.py
+# negative fixture for the jit-* family: the blessed idioms — bucketed
+# statics, a memoized jit factory, donate-with-rebind — must stay
+# finding-free. Never scanned by the real linter.
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _bucket(n, floor=8):
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+def _score_impl(x, k):
+    return jnp.sum(x[:k])
+
+
+_score = jax.jit(_score_impl, static_argnames=("k",))
+_update = jax.jit(lambda s, x: s + x, donate_argnums=(0,))
+
+
+def bucketed_static(xs, q):
+    k = _bucket(len(xs))
+    return _score(q, k=k)
+
+
+@lru_cache(maxsize=None)
+def make_kernel(k):
+    return jax.jit(partial(_score_impl, k=k))
+
+
+def donate_with_rebind(state, x):
+    state = _update(state, x)
+    return state

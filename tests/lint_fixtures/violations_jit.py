@@ -1,0 +1,53 @@
+# osselint: path=open_source_search_engine_tpu/query/fixture_jit.py
+# osselint jit-family fixture — the pragma above re-scopes it to a
+# virtual query/ path so the jit-* rules apply. Each "EXPECT rule"
+# comment marks the line a finding must anchor to. Never scanned by
+# the real linter (lint_fixtures/ is excluded from directory walks).
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TUNING = {"tilt": 1.5}
+
+
+def _score_impl(x, k):
+    return jnp.sum(x[:k])
+
+
+_score = jax.jit(_score_impl, static_argnames=("k",))
+
+
+def _update_impl(state, x):
+    return state + x
+
+
+_update = jax.jit(_update_impl, donate_argnums=(0,))
+
+
+@jax.jit
+def _tilted(x):
+    return x * TUNING["tilt"]  # EXPECT jit-mutable-closure
+
+
+def unstable_statics(xs, q):
+    n = len(xs)
+    a = _score(q, k=n)  # EXPECT jit-unstable-static
+    b = _score(q, k=1.5)  # EXPECT jit-unstable-static
+    return a, b
+
+
+def wrap_per_call(x):
+    fn = jax.jit(lambda v: v * 2)  # EXPECT jit-in-body
+    return fn(x)
+
+
+def donate_then_read(state, x):
+    out = _update(state, x)
+    return out + state  # EXPECT jit-donated-reuse
+
+
+def hidden_sync(q):
+    s = _score(q, k=8)
+    lo = float(s)  # EXPECT jit-implicit-transfer
+    hi = np.asarray(s)  # EXPECT jit-implicit-transfer
+    return lo, hi

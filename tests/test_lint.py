@@ -49,45 +49,41 @@ class TestTreeIsClean:
         assert not any("lint_fixtures" in f.parts for f in files)
 
 
+def _violation_fixtures():
+    return sorted(FIXTURES.glob("violations_*.py"))
+
+
 class TestFixtures:
-    def test_every_rule_fires_where_expected(self):
-        """The violations fixture carries ``# EXPECT rule`` markers;
+    @pytest.mark.parametrize(
+        "fixture", _violation_fixtures(), ids=lambda p: p.stem)
+    def test_every_rule_fires_where_expected(self, fixture):
+        """Each violations fixture carries ``# EXPECT rule`` markers;
         the finding set must equal the marker set exactly — no missed
         violations, no spurious ones."""
-        src = (FIXTURES / "violations_parallel.py").read_text()
         expected = set()
-        for i, line in enumerate(src.splitlines(), start=1):
+        for i, line in enumerate(fixture.read_text().splitlines(),
+                                 start=1):
             for rule in re.findall(r"# EXPECT ([a-z\-]+)", line):
                 expected.add((i, rule))
-        got = {(f.line, f.rule) for f in
-               _lint_file(FIXTURES / "violations_parallel.py")}
+        got = {(f.line, f.rule) for f in _lint_file(fixture)}
         assert got == expected, (
             f"missed: {sorted(expected - got)}\n"
             f"spurious: {sorted(got - expected)}")
 
     def test_all_rules_covered_by_fixture(self):
-        """Every registered rule has at least one positive case."""
-        src = (FIXTURES / "violations_parallel.py").read_text()
-        covered = set(re.findall(r"# EXPECT ([a-z\-]+)", src))
+        """Every registered rule has at least one positive case
+        somewhere in the violations fixtures."""
+        covered = set()
+        for fixture in _violation_fixtures():
+            covered |= set(re.findall(r"# EXPECT ([a-z\-]+)",
+                                      fixture.read_text()))
         assert covered == osselint.RULE_NAMES
 
-    def test_resident_fence_fixture_matches_markers(self):
-        """The resident-loop fixture pins the device-sync rule's
-        extended fence (device_put/asarray banned alongside the sync
-        calls) to exact lines."""
-        src = (FIXTURES / "violations_resident.py").read_text()
-        expected = set()
-        for i, line in enumerate(src.splitlines(), start=1):
-            for rule in re.findall(r"# EXPECT ([a-z\-]+)", line):
-                expected.add((i, rule))
-        got = {(f.line, f.rule) for f in
-               _lint_file(FIXTURES / "violations_resident.py")}
-        assert got == expected, (
-            f"missed: {sorted(expected - got)}\n"
-            f"spurious: {sorted(got - expected)}")
-
-    def test_clean_fixture_has_no_findings(self):
-        findings = _lint_file(FIXTURES / "clean_parallel.py")
+    @pytest.mark.parametrize(
+        "fixture", sorted(FIXTURES.glob("clean_*.py")),
+        ids=lambda p: p.stem)
+    def test_clean_fixture_has_no_findings(self, fixture):
+        findings = _lint_file(fixture)
         assert not findings, [(f.line, f.rule) for f in findings]
 
     def test_waiver_suppresses_and_scopes_to_named_rule(self):
@@ -154,16 +150,78 @@ class TestSeededRegressions:
             src, "open_source_search_engine_tpu/utils/stats.py") == []
 
 
+class TestJitSeededRegressions:
+    """The literal jit hazard shapes the PR 7 rules caught (or
+    deliberately exempt) in the live tree."""
+
+    def test_unbucketed_local_k_is_caught_and_bucket_fixes_it(self):
+        # the sharded.py bug: local_k derived from topk+offset and a
+        # len() max — one shard_map compile per distinct page size
+        src = ("import jax\n"
+               "def _impl(x, local_k):\n"
+               "    return x[:local_k]\n"
+               "_shard = jax.jit(_impl, static_argnames=('local_k',))\n"
+               "def dispatch(x, plans, topk, offset):\n"
+               "    D = max(len(p) for p in plans)\n"
+               "    k = min(topk + offset, D)\n"
+               "    return _shard(x, local_k=k)\n")
+        found = osselint.check_source(
+            src, "open_source_search_engine_tpu/parallel/mesh.py")
+        assert [f.rule for f in found] == ["jit-unstable-static"]
+        fixed = src.replace("k = min(topk + offset, D)",
+                            "k = min(_bucket(topk + offset), D)")
+        assert osselint.check_source(
+            fixed,
+            "open_source_search_engine_tpu/parallel/mesh.py") == []
+
+    def test_cached_jit_factory_is_exempt(self):
+        # devcheck._checked: an lru_cache'd factory mints one wrapper
+        # per key — the safe jit-in-body idiom
+        src = ("import functools\n"
+               "import jax\n"
+               "@functools.lru_cache(maxsize=None)\n"
+               "def _checked(name):\n"
+               "    return jax.jit(lambda x: x)\n")
+        assert osselint.check_source(
+            src, "open_source_search_engine_tpu/query/devcheck.py") \
+            == []
+        bare = src.replace(
+            "@functools.lru_cache(maxsize=None)\n", "")
+        found = osselint.check_source(
+            bare, "open_source_search_engine_tpu/query/devcheck.py")
+        assert [f.rule for f in found] == ["jit-in-body"]
+
+    def test_donated_rebind_idiom_is_exempt(self):
+        # devindex._build_delta: self.d_X = _write_tail(self.d_X, ...)
+        # rebinds the donated buffer — safe; reading it without the
+        # rebind is the hazard
+        src = ("import jax\n"
+               "_wt = jax.jit(lambda b, v: b, donate_argnums=(0,))\n"
+               "class D:\n"
+               "    def build(self, v):\n"
+               "        self.d_pos = _wt(self.d_pos, v)\n"
+               "        return self.d_pos\n")
+        assert osselint.check_source(
+            src, "open_source_search_engine_tpu/query/devindex.py") \
+            == []
+        bad = src.replace("self.d_pos = _wt(self.d_pos, v)",
+                          "out = _wt(self.d_pos, v)")
+        found = osselint.check_source(
+            bad, "open_source_search_engine_tpu/query/devindex.py")
+        assert [f.rule for f in found] == ["jit-donated-reuse"]
+
+
 class TestCli:
-    def test_violating_file_exits_nonzero_with_json(self):
+    def test_violating_files_exit_nonzero_with_json(self):
+        fixtures = _violation_fixtures()
         proc = subprocess.run(
-            [sys.executable, "-m", "tools.osselint", "--format=json",
-             str(FIXTURES / "violations_parallel.py")],
+            [sys.executable, "-m", "tools.osselint", "--format=json"]
+            + [str(f) for f in fixtures],
             cwd=ROOT, capture_output=True, text=True, timeout=60)
         assert proc.returncode == 1
         import json
         payload = json.loads(proc.stdout)
-        assert payload["files"] == 1
+        assert payload["files"] == len(fixtures)
         assert {f["rule"] for f in payload["findings"]} \
             == osselint.RULE_NAMES
 
@@ -206,6 +264,54 @@ class TestCli:
              "--root", str(repo)],
             cwd=ROOT, capture_output=True, text=True, timeout=60)
         assert proc.returncode == 0, proc.stdout
+
+    def test_changed_mode_handles_rename_and_delete(self, tmp_path):
+        """A staged rename must be linted under its NEW path and a
+        staged delete must contribute nothing — neither may crash the
+        diff parse (R/C rows carry two paths, D rows a missing file)."""
+        repo = tmp_path / "repo"
+        pkg = repo / "open_source_search_engine_tpu" / "parallel"
+        pkg.mkdir(parents=True)
+        (pkg / "bad.py").write_text(
+            "import urllib.request\n"
+            "x = urllib.request.urlopen('http://example.com')\n")
+        (pkg / "gone.py").write_text("import urllib.request\n"
+                                     "y = 1\n")
+        for args in (["git", "init", "-q"],
+                     ["git", "add", "-A"],
+                     ["git", "-c", "user.email=t@t", "-c",
+                      "user.name=t", "commit", "-qm", "seed"]):
+            subprocess.run(args, cwd=repo, check=True,
+                           capture_output=True)
+        subprocess.run(["git", "mv", str(pkg / "bad.py"),
+                        str(pkg / "moved.py")], cwd=repo, check=True,
+                       capture_output=True)
+        subprocess.run(["git", "rm", "-q", str(pkg / "gone.py")],
+                       cwd=repo, check=True, capture_output=True)
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.osselint", "--changed",
+             "--format=json", "--root", str(repo)],
+            cwd=ROOT, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 1, proc.stderr
+        import json
+        payload = json.loads(proc.stdout)
+        paths = {f["path"] for f in payload["findings"]}
+        assert paths == {
+            "open_source_search_engine_tpu/parallel/moved.py"}
+        assert {f["rule"] for f in payload["findings"]} \
+            == {"urllib-in-parallel"}
+
+
+class TestCheckGate:
+    def test_check_sh_lint_gate_passes_on_tree(self):
+        """tools/check.sh --lint-only (tree lint + fixture sanity) is
+        the one-command gate; --lint-only stops before the pytest
+        slice so this test doesn't recurse into itself."""
+        proc = subprocess.run(
+            ["bash", str(ROOT / "tools" / "check.sh"), "--lint-only"],
+            cwd=ROOT, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "lint gate OK" in proc.stdout
 
 
 class TestRuleMechanics:

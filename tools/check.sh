@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# One-command PR gate: tree-wide lint, fixture sanity, fast tier-1
+# slice. Builders and future PRs run this instead of remembering the
+# pieces; tests/test_lint.py invokes `check.sh --lint-only` so the
+# gate itself stays tested (the flag stops before pytest — otherwise
+# the gate would recurse into the test that runs it).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# 1. the whole tree must be invariant-clean
+python -m tools.osselint
+
+# 2. fixture sanity via the CLI: clean fixtures lint clean, violation
+#    fixtures actually produce findings (the exact-line marker match
+#    lives in tests/test_lint.py)
+python -m tools.osselint tests/lint_fixtures/clean_parallel.py \
+    tests/lint_fixtures/clean_jit.py
+for f in tests/lint_fixtures/violations_*.py; do
+    if python -m tools.osselint "$f" > /dev/null 2>&1; then
+        echo "check.sh: $f produced no findings" >&2
+        exit 1
+    fi
+done
+
+if [ "${1:-}" = "--lint-only" ]; then
+    echo "check.sh: lint gate OK"
+    exit 0
+fi
+
+# 3. fast tier-1 slice: the lint gate, the jit plane, and the query
+#    stack (the layers a typical PR touches)
+JAX_PLATFORMS=cpu python -m pytest tests/test_lint.py \
+    tests/test_jitwatch.py tests/test_query.py -q -m 'not slow' \
+    -p no:cacheprovider
+echo "check.sh: OK"

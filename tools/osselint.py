@@ -30,6 +30,31 @@ reference-engine discipline that keeps it from shipping one):
   host sync; outside the two blessed device-boundary modules they
   silently serialize the TPU pipeline.
 
+The ``jit-*`` family covers JAX trace discipline — the failure modes
+are invisible until they show up as a latency cliff (the Gigablast
+analog: Msg39 latency spikes when a query shape misses every warm
+plan):
+
+* ``jit-unstable-static`` — a float / container / array /
+  unbucketed ``len()``-derived value passed to a ``static_argnames``
+  parameter: every distinct value is a fresh XLA compile (retrace
+  cliff + unbounded jit cache).
+* ``jit-in-body`` — ``jax.jit(...)`` wrapped inside a function body:
+  each call mints a fresh wrapper with an empty compile cache, so
+  nothing is ever warm (memoized factories via ``lru_cache`` are the
+  sanctioned escape).
+* ``jit-mutable-closure`` — a jitted function reading module-level
+  mutable state: the value is frozen into the traced program at
+  compile time and silently goes stale when the dict/list mutates.
+* ``jit-donated-reuse`` — an argument donated via ``donate_argnums``
+  read after the donating call: donation deallocates the buffer; the
+  read returns garbage (or crashes) on real backends.
+* ``jit-implicit-transfer`` — ``float()`` / ``.item()`` /
+  ``np.asarray()`` / ``.tolist()`` on a device value outside the
+  device-boundary modules (devindex, scorer, sharded): an implicit
+  device→host sync on the request path, exactly the hidden
+  serialization the resident loop exists to avoid.
+
 Waive a finding with a trailing comment on its line::
 
     risky_call()  # osselint: ignore[rule-name] — why it is safe here
@@ -364,9 +389,10 @@ def _thread_scope(rel: str) -> bool:
     return _in_pkg(rel) and rel != f"{PKG}/utils/threads.py"
 
 
-def rule_locked_global(ctx: Ctx) -> list[Finding]:
+def _module_mutables(tree: ast.Module) -> set[str]:
+    """Module-level names bound to mutable containers."""
     mutables: set[str] = set()
-    for stmt in ctx.tree.body:
+    for stmt in tree.body:
         targets: list[ast.expr] = []
         if isinstance(stmt, ast.Assign):
             targets, value = stmt.targets, stmt.value
@@ -387,6 +413,11 @@ def rule_locked_global(ctx: Ctx) -> list[Finding]:
         for t in targets:
             if isinstance(t, ast.Name):
                 mutables.add(t.id)
+    return mutables
+
+
+def rule_locked_global(ctx: Ctx) -> list[Finding]:
+    mutables = _module_mutables(ctx.tree)
     if not mutables:
         return []
 
@@ -466,6 +497,411 @@ def _device_scope(rel: str) -> bool:
         f"{PKG}/query/devindex.py", f"{PKG}/query/scorer.py")
 
 
+# ---------------------------------------------------------------------------
+# jit trace-discipline family
+# ---------------------------------------------------------------------------
+
+#: modules that OWN device↔host traffic: devindex's collect path and
+#: scorer's packed fetch (the device-sync boundary) plus the mesh
+#: path's replicated-output materialization in sharded.py
+_JIT_TRANSFER_BOUNDARY = (
+    f"{PKG}/query/devindex.py", f"{PKG}/query/scorer.py",
+    f"{PKG}/parallel/sharded.py")
+
+_ARRAYISH_CALLS = {"np.array", "np.asarray", "numpy.array",
+                   "numpy.asarray", "jnp.array", "jnp.asarray",
+                   "jax.numpy.array", "jax.numpy.asarray"}
+
+#: decorators that make a jit-wrapping factory safe (one wrapper per
+#: distinct key, not one per call)
+_CACHED_DECOS = {"lru_cache", "cache", "cached_property"}
+
+_MATERIALIZERS = {"float", "int", "bool"}
+_HOST_ARRAY_CALLS = {"np.asarray", "np.array", "numpy.asarray",
+                     "numpy.array"}
+_MATERIALIZE_METHODS = {"item", "tolist", "__array__"}
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return dotted(node) == "jax.jit"
+
+
+def _jit_wrap_call(node: ast.Call) -> bool:
+    """``jax.jit(...)`` or ``[functools.]partial(jax.jit, ...)``."""
+    if _is_jax_jit(node.func):
+        return True
+    fn = dotted(node.func)
+    return fn in ("partial", "functools.partial") \
+        and bool(node.args) and _is_jax_jit(node.args[0])
+
+
+def _jit_kwargs(call: ast.Call) -> tuple[set[str], set[int]]:
+    """(static_argnames, donate_argnums) literals of a jit wrap."""
+    statics: set[str] = set()
+    donate: set[int] = set()
+    for kw in call.keywords:
+        vals = kw.value.elts if isinstance(kw.value, ast.Tuple) \
+            else [kw.value]
+        if kw.arg == "static_argnames":
+            statics |= {v.value for v in vals
+                        if isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)}
+        elif kw.arg == "donate_argnums":
+            donate |= {v.value for v in vals
+                       if isinstance(v, ast.Constant)
+                       and isinstance(v.value, int)}
+    return statics, donate
+
+
+@dataclass
+class _JitSite:
+    name: str
+    statics: set
+    donate: set
+    def_node: ast.FunctionDef | None
+
+
+def _jit_registry(ctx: Ctx) -> dict[str, _JitSite]:
+    """Per-file map of names bound to jit-wrapped callables: decorated
+    defs (``@jax.jit`` / ``@partial(jax.jit, ...)``) plus module-level
+    ``name = jax.jit(fn, ...)`` rebinds."""
+    reg = getattr(ctx, "_jit_reg", None)
+    if reg is not None:
+        return reg
+    reg = {}
+    defs = {n.name: n for n in ast.walk(ctx.tree)
+            if isinstance(n, ast.FunctionDef)}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.FunctionDef):
+            for deco in node.decorator_list:
+                if _is_jax_jit(deco):
+                    statics, donate = set(), set()
+                elif isinstance(deco, ast.Call) and _jit_wrap_call(deco):
+                    statics, donate = _jit_kwargs(deco)
+                else:
+                    continue
+                reg[node.name] = _JitSite(node.name, statics, donate,
+                                          node)
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call) \
+                and _is_jax_jit(node.value.func):
+            statics, donate = _jit_kwargs(node.value)
+            inner = node.value.args[0] if node.value.args else None
+            def_node = defs.get(inner.id) \
+                if isinstance(inner, ast.Name) else None
+            reg[node.targets[0].id] = _JitSite(
+                node.targets[0].id, statics, donate, def_node)
+    ctx._jit_reg = reg
+    return reg
+
+
+def _enclosing_function(ctx: Ctx, node: ast.AST):
+    for _c, p in ctx.ancestors(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return p
+    return None
+
+
+def _local_exprs(fn: ast.AST | None) -> dict[str, list[ast.AST]]:
+    """name → RHS expressions assigned to it inside ``fn`` — the few
+    hops of local dataflow static-arg provenance needs."""
+    out: dict[str, list[ast.AST]] = {}
+    if fn is None:
+        return out
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            out.setdefault(node.targets[0].id, []).append(node.value)
+        elif isinstance(node, ast.AugAssign) \
+                and isinstance(node.target, ast.Name):
+            out.setdefault(node.target.id, []).append(node.value)
+    return out
+
+
+def _value_nodes(expr: ast.AST):
+    """Like ast.walk, but skips ``IfExp`` tests: a conditional
+    quantizes a value into its branch set (``A if n <= A else B`` is
+    two-valued however ``n`` was derived), so sizes read only in the
+    test don't make the value unstable."""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, ast.IfExp):
+            stack.extend((node.body, node.orelse))
+        else:
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _expr_matches(expr, amap, pred, depth=4, seen=None) -> bool:
+    """Does ``pred`` hit any node of ``expr``, chasing local Name
+    assignments up to ``depth`` hops?"""
+    if seen is None:
+        seen = set()
+    for node in _value_nodes(expr):
+        if pred(node):
+            return True
+        if depth > 0 and isinstance(node, ast.Name) \
+                and isinstance(node.ctx, ast.Load) \
+                and node.id not in seen and node.id in amap:
+            seen.add(node.id)
+            for rhs in amap[node.id]:
+                if _expr_matches(rhs, amap, pred, depth - 1, seen):
+                    return True
+    return False
+
+
+def _is_len_or_shape(node: ast.AST) -> bool:
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id == "len":
+        return True
+    # x.shape[i] — a runtime size is just as unstable as len()
+    return isinstance(node, ast.Subscript) \
+        and isinstance(node.value, ast.Attribute) \
+        and node.value.attr == "shape"
+
+
+def _is_bucketish(node: ast.AST) -> bool:
+    if isinstance(node, ast.Call):
+        ident = _final_ident(node.func)
+        return ident is not None and "bucket" in ident.lower()
+    return False
+
+
+def rule_jit_unstable_static(ctx: Ctx) -> list[Finding]:
+    """Unstable value passed to a static_argnames parameter — every
+    distinct value is a fresh XLA compile (retrace cliff + unbounded
+    jit cache)."""
+    reg = _jit_registry(ctx)
+    out: list[Finding] = []
+    if not reg:
+        return out
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in reg):
+            continue
+        site = reg[node.func.id]
+        if not site.statics:
+            continue
+        amap = _local_exprs(_enclosing_function(ctx, node))
+        for kw in node.keywords:
+            if kw.arg not in site.statics:
+                continue
+            frag = None
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) \
+                        and isinstance(n.value, float):
+                    frag = "a float"
+                elif isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Name) \
+                        and n.func.id == "float":
+                    frag = "a float()"
+                elif isinstance(n, (ast.Dict, ast.List, ast.Set,
+                                    ast.DictComp, ast.ListComp,
+                                    ast.SetComp)):
+                    frag = "an unhashable container"
+                elif isinstance(n, ast.Call) \
+                        and dotted(n.func) in _ARRAYISH_CALLS:
+                    frag = "an array value"
+                if frag:
+                    break
+            if frag is None \
+                    and _expr_matches(kw.value, amap, _is_len_or_shape) \
+                    and not _expr_matches(kw.value, amap, _is_bucketish):
+                frag = "a len()/shape-derived value with no bucket " \
+                       "rounding"
+            if frag:
+                out.append(Finding(
+                    ctx.rel, kw.value.lineno, "jit-unstable-static",
+                    f"{frag} passed to static arg '{kw.arg}' of "
+                    f"{node.func.id}() — every distinct value is a "
+                    "fresh XLA compile; statics must be bucketed "
+                    "stable ints/bools (query/packer._bucket)"))
+    return out
+
+
+def rule_jit_in_body(ctx: Ctx) -> list[Finding]:
+    """jax.jit wrapped inside a function body — a fresh wrapper (and
+    empty compile cache) per call, so nothing is ever warm."""
+    out: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and _jit_wrap_call(node)):
+            continue
+        encl = None
+        for child, parent in ctx.ancestors(node):
+            if isinstance(parent, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                if child in parent.decorator_list:
+                    continue  # decorator position == module-level wrap
+                encl = parent
+                break
+        if encl is None:
+            continue
+        if any(_final_ident(d) in _CACHED_DECOS
+               for d in encl.decorator_list):
+            continue  # memoized factory: one wrapper per key
+        out.append(Finding(
+            ctx.rel, node.lineno, "jit-in-body",
+            f"jax.jit inside {encl.name}() — a fresh wrapper (and "
+            "compile cache) per call; hoist to module level or "
+            "memoize the factory with lru_cache"))
+    return out
+
+
+def _jit_body_scope(rel: str) -> bool:
+    return any(rel.startswith(f"{PKG}/{d}/")
+               for d in ("query", "parallel", "serve"))
+
+
+def rule_jit_mutable_closure(ctx: Ctx) -> list[Finding]:
+    """A jitted function reading module-level mutable state — the
+    value is frozen into the traced program and silently goes stale
+    when the container mutates."""
+    reg = _jit_registry(ctx)
+    muts = _module_mutables(ctx.tree)
+    out: list[Finding] = []
+    if not (reg and muts):
+        return out
+    for site in reg.values():
+        fn = site.def_node
+        if fn is None:
+            continue
+        a = fn.args
+        local = {p.arg for p in
+                 a.args + a.kwonlyargs + a.posonlyargs}
+        for va in (a.vararg, a.kwarg):
+            if va is not None:
+                local.add(va.arg)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Store):
+                local.add(node.id)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and node.id in muts and node.id not in local:
+                out.append(Finding(
+                    ctx.rel, node.lineno, "jit-mutable-closure",
+                    f"jitted {fn.name}() reads module-level mutable "
+                    f"'{node.id}' at trace time — the traced value is "
+                    "frozen into the compiled program and goes stale "
+                    "when the container mutates; pass it as an "
+                    "argument"))
+    return out
+
+
+def rule_jit_donated_reuse(ctx: Ctx) -> list[Finding]:
+    """An argument donated via donate_argnums read after the donating
+    call — donation deallocates the buffer; the read returns garbage
+    (or crashes) on real backends."""
+    reg = _jit_registry(ctx)
+    donators = {n: s for n, s in reg.items() if s.donate}
+    out: list[Finding] = []
+    if not donators:
+        return out
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in donators):
+            continue
+        site = donators[node.func.id]
+        encl = _enclosing_function(ctx, node)
+        if encl is None:
+            continue
+        targets: set[str] = set()
+        parent = ctx.parents.get(node)
+        if isinstance(parent, ast.Assign) and parent.value is node:
+            targets = {dotted(t) for t in parent.targets} - {None}
+        end = getattr(node, "end_lineno", node.lineno)
+        for pos in site.donate:
+            if pos >= len(node.args):
+                continue
+            dn = dotted(node.args[pos])
+            if dn is None or dn in targets:
+                continue  # rebind of the donated name: the safe idiom
+            for later in ast.walk(encl):
+                if isinstance(later, (ast.Name, ast.Attribute)) \
+                        and later.lineno > end \
+                        and isinstance(getattr(later, "ctx", None),
+                                       ast.Load) \
+                        and dotted(later) == dn:
+                    out.append(Finding(
+                        ctx.rel, later.lineno, "jit-donated-reuse",
+                        f"'{dn}' donated to {node.func.id}() on line "
+                        f"{node.lineno} is read afterwards — donation "
+                        "deallocates the buffer; rebind the result to "
+                        f"'{dn}' or drop donate_argnums"))
+                    break
+    return out
+
+
+def _device_producer(call: ast.Call, reg) -> bool:
+    name = dotted(call.func)
+    if name is None:
+        return False
+    if isinstance(call.func, ast.Name) and name in reg:
+        return True
+    return name.startswith(("jnp.", "jax.numpy.")) \
+        or name == "jax.device_put"
+
+
+def rule_jit_implicit_transfer(ctx: Ctx) -> list[Finding]:
+    """float()/.item()/np.asarray()/.tolist() on a device value
+    outside the device-boundary modules — an implicit device→host
+    sync on the request path."""
+    reg = _jit_registry(ctx)
+    # device-valued local names: single-name targets assigned from a
+    # jit-wrapped or jnp-producing call, keyed by enclosing function
+    dev_by_fn: dict[int, set[str]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call) \
+                and _device_producer(node.value, reg):
+            fnkey = id(_enclosing_function(ctx, node) or ctx.tree)
+            dev_by_fn.setdefault(fnkey, set()).add(node.targets[0].id)
+
+    def is_dev(expr: ast.AST, fnkey: int) -> bool:
+        if isinstance(expr, ast.Name) \
+                and expr.id in dev_by_fn.get(fnkey, ()):
+            return True
+        return isinstance(expr, ast.Call) \
+            and _device_producer(expr, reg)
+
+    out: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fnkey = id(_enclosing_function(ctx, node) or ctx.tree)
+        name = dotted(node.func)
+        hit = None
+        if isinstance(node.func, ast.Name) \
+                and name in _MATERIALIZERS \
+                and node.args and is_dev(node.args[0], fnkey):
+            hit = f"{name}()"
+        elif name in _HOST_ARRAY_CALLS and node.args \
+                and is_dev(node.args[0], fnkey):
+            hit = f"{name}()"
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MATERIALIZE_METHODS \
+                and is_dev(node.func.value, fnkey):
+            hit = f".{node.func.attr}()"
+        if hit:
+            out.append(Finding(
+                ctx.rel, node.lineno, "jit-implicit-transfer",
+                f"{hit} on a device value outside the device boundary "
+                "— an implicit host sync serializes the pipeline; "
+                "fetch at the boundary (devindex collect / scorer / "
+                "sharded) or keep the value on device"))
+    return out
+
+
+def _jit_transfer_scope(rel: str) -> bool:
+    return _in_pkg(rel) and rel not in _JIT_TRANSFER_BOUNDARY
+
+
 #: (rule-name, path predicate, checker)
 RULES = [
     ("ttlcache-offplane", _ttl_scope, rule_ttlcache_offplane),
@@ -478,6 +914,12 @@ RULES = [
     ("thread-spawn", _thread_scope, rule_thread_spawn),
     ("locked-global", _locked_global_scope, rule_locked_global),
     ("device-sync", _device_scope, rule_device_sync),
+    ("jit-unstable-static", _in_pkg, rule_jit_unstable_static),
+    ("jit-in-body", _jit_body_scope, rule_jit_in_body),
+    ("jit-mutable-closure", _in_pkg, rule_jit_mutable_closure),
+    ("jit-donated-reuse", _in_pkg, rule_jit_donated_reuse),
+    ("jit-implicit-transfer", _jit_transfer_scope,
+     rule_jit_implicit_transfer),
 ]
 
 RULE_NAMES = {name for name, _p, _c in RULES}
@@ -529,16 +971,41 @@ def iter_py_files(paths: list[Path], root: Path) -> list[Path]:
 
 
 def changed_files(root: Path) -> list[Path]:
-    """Files touched vs. HEAD: unstaged + staged + untracked."""
+    """Files touched vs. HEAD: unstaged + staged + untracked.
+
+    Parsed from NUL-separated ``--name-status`` records so rename and
+    delete entries are handled explicitly: a rename (``R``/``C``, two
+    path fields) contributes its NEW path, a deletion contributes
+    nothing (the old path no longer exists to lint), and ``-z``
+    sidesteps git's path quoting for unusual filenames."""
     import subprocess
     names: set[str] = set()
-    for args in (["git", "diff", "--name-only", "HEAD"],
-                 ["git", "diff", "--name-only", "--cached"],
-                 ["git", "ls-files", "--others", "--exclude-standard"]):
+    for args in (["git", "diff", "--name-status", "-z", "-M", "HEAD"],
+                 ["git", "diff", "--name-status", "-z", "-M",
+                  "--cached"]):
         proc = subprocess.run(args, cwd=root, capture_output=True,
                               text=True, check=False)
-        names.update(line.strip() for line in proc.stdout.splitlines()
-                     if line.strip())
+        fields = proc.stdout.split("\0")
+        i = 0
+        while i < len(fields):
+            status = fields[i].strip()
+            if not status:
+                i += 1
+                continue
+            if status[0] in "RC":  # rename/copy: status, old, new
+                if i + 2 < len(fields) and fields[i + 2]:
+                    names.add(fields[i + 2])
+                i += 3
+            elif status[0] == "D":  # deletion: nothing left to lint
+                i += 2
+            else:
+                if i + 1 < len(fields) and fields[i + 1]:
+                    names.add(fields[i + 1])
+                i += 2
+    proc = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard", "-z"],
+        cwd=root, capture_output=True, text=True, check=False)
+    names.update(n for n in proc.stdout.split("\0") if n)
     out = []
     for n in sorted(names):
         p = root / n
