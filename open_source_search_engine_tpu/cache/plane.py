@@ -206,6 +206,23 @@ class GenCache:
         hit, v = self.lookup(key, gen=gen)
         return v if hit else default
 
+    def lookup_stale(self, key: Hashable, gen: Any = _UNSET
+                     ) -> tuple[bool, Any]:
+        """``(hit, value)`` ignoring TTL expiry — but never crossing a
+        generation move (a write still invalidates; only time is
+        softened). The deadline plane uses this: a just-expired answer
+        served as degraded beats refusing an over-budget query."""
+        if not self.enabled:
+            return False, None
+        g = self._gen(gen)
+        with self._lock:
+            e = self._d.get(key)
+            if e is not None and e[1] == g:
+                self.stale_served += 1
+                g_stats.count(f"cache.{self.name}.stale")
+                return True, e[3]
+            return False, None
+
     def put(self, key: Hashable, value: Any, ttl_s: float | None = None,
             gen: Any = _UNSET, cost: int | None = None) -> None:
         if not self.enabled:

@@ -40,9 +40,11 @@ from pathlib import Path
 
 import numpy as np
 
+from ..utils.chaos import g_chaos
 from ..utils.lockcheck import make_rlock
 from ..utils.log import get_logger
 from ..utils.membudget import g_membudget
+from ..utils.stats import g_stats
 
 log = get_logger("rdb")
 
@@ -732,6 +734,7 @@ class Rdb:
                 r.path.rename(q)
                 self.quarantined.append(q.name)
                 bad.append(q.name)
+                g_stats.count("rdb.corrupt_quarantined")
                 log.error("%s: QUARANTINED corrupt run: %s",
                           self.name, e)
         if bad:
@@ -758,6 +761,8 @@ class Rdb:
 
     def get_list(self, start_key: np.ndarray, end_key: np.ndarray) -> RecordBatch:
         """Merged range read across runs + memtable, tombstones applied."""
+        if g_chaos.enabled:
+            g_chaos.rdb_fault(self)
         sources = [r.batch().range(start_key, end_key) for r in self.runs]
         sources.append(self.mem.range(start_key, end_key))
         return merge_batches(sources)
@@ -832,6 +837,7 @@ class Rdb:
                     shutil.rmtree(q)
                 p.rename(q)
                 self.quarantined.append(q.name)
+                g_stats.count("rdb.corrupt_quarantined")
                 log.error("%s: QUARANTINED corrupt run: %s",
                           self.name, e)
         self.load_saved()

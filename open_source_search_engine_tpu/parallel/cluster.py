@@ -49,6 +49,8 @@ import numpy as np
 
 from ..cache import g_cacheplane
 from ..index.collection import Collection
+from ..utils import chaos as chaos_mod
+from ..utils import deadline as deadline_mod
 from ..utils import ghash
 from ..utils import threads
 from ..utils import trace as trace_mod
@@ -258,6 +260,12 @@ class ShardNodeServer:
                 return {"ok": bool(ok),
                         "gen": self.coll.posdb.version}
             if path == "/rpc/search":
+                if deadline_mod.check_abandon("node.search"):
+                    # second checkpoint past the dequeue one: the wait
+                    # for the writer lock may have eaten what was left
+                    # of the budget — abandon before the device wave
+                    raise deadline_mod.DeadlineExceeded(
+                        "deadline exceeded")
                 topk = int(payload.get("topk", 10))
                 lang = int(payload.get("lang", 0))
                 # replies are cached per (q, topk, lang) under the
@@ -534,22 +542,37 @@ class ShardNodeServer:
                 # graft into its tree (Dapper-style child spans)
                 tr_hdr = trace_mod.parse_header(
                     self.headers.get(trace_mod.TRACE_HEADER) or "")
+                # rebuild the coordinator's deadline from the budget it
+                # shipped (wall clocks don't cross hosts; budgets do)
+                dl = deadline_mod.Deadline.from_header(
+                    self.headers.get(deadline_mod.DEADLINE_HEADER))
                 outer.nice_gate.enter(nice)
                 try:
-                    payload = transport_mod.decode_body(
-                        body, self.headers.get("Content-Type", ""))
-                    if tr_hdr is not None:
-                        with trace_mod.g_tracer.adopt(
-                                tr_hdr[0], tr_hdr[1],
-                                self.path.lstrip("/"),
-                                host=f"{outer.host}:{outer.port}"
-                                ) as adopted:
-                            out = outer.handle(self.path, payload)
-                        if isinstance(out, dict):
-                            out["_trace"] = adopted.export()
+                    if chaos_mod.g_chaos.enabled:
+                        chaos_mod.g_chaos.node_fault(outer)
+                    if deadline_mod.check_abandon("node.dequeue", dl):
+                        # the coordinator already timed out — abandon
+                        # at the door, before the writer lock and the
+                        # device wave burn work nobody is waiting for
+                        out, code = {"ok": False,
+                                     "error": "deadline exceeded"}, 504
                     else:
-                        out = outer.handle(self.path, payload)
-                    code = 200
+                        payload = transport_mod.decode_body(
+                            body, self.headers.get("Content-Type", ""))
+                        with deadline_mod.bind(dl):
+                            if tr_hdr is not None:
+                                with trace_mod.g_tracer.adopt(
+                                        tr_hdr[0], tr_hdr[1],
+                                        self.path.lstrip("/"),
+                                        host=f"{outer.host}:{outer.port}"
+                                        ) as adopted:
+                                    out = outer.handle(self.path,
+                                                       payload)
+                                if isinstance(out, dict):
+                                    out["_trace"] = adopted.export()
+                            else:
+                                out = outer.handle(self.path, payload)
+                        code = 200
                 except KeyError:
                     out, code = {"error": "no such rpc"}, 404
                 except Exception as e:  # noqa: BLE001 — node must not die
@@ -561,18 +584,25 @@ class ShardNodeServer:
                 # errors stay JSON so any peer can read them
                 data, ctype = transport_mod.encode_body(
                     out, accept_bin and code == 200)
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(data)))
-                # every reply advertises this node's Rdb generation:
-                # the client cache plane folds it in (transport
-                # gen_observer) so even a read reply reveals that a
-                # write landed — no stale window beyond one in-flight
-                # read
-                self.send_header(transport_mod.GEN_HEADER,
-                                 str(outer.coll.posdb.version))
-                self.end_headers()
-                self.wfile.write(data)
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(data)))
+                    # every reply advertises this node's Rdb generation:
+                    # the client cache plane folds it in (transport
+                    # gen_observer) so even a read reply reveals that a
+                    # write landed — no stale window beyond one
+                    # in-flight read
+                    self.send_header(transport_mod.GEN_HEADER,
+                                     str(outer.coll.posdb.version))
+                    self.end_headers()
+                    self.wfile.write(data)
+                except OSError:
+                    # connection severed under us (stop() / a chaos
+                    # kill) — the client's hedge already treats this
+                    # leg as failed; don't let the handler thread die
+                    # loudly
+                    self.close_connection = True
 
             do_GET = do_POST
 
@@ -584,10 +614,13 @@ class ShardNodeServer:
                  self.coll.num_docs)
 
     def stop(self) -> None:
-        if self._httpd:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
+        # claim-then-close so concurrent stops (a chaos kill from a
+        # side thread racing a test/operator teardown) are safe: only
+        # one caller gets the live httpd, the rest see None
+        httpd, self._httpd = self._httpd, None
+        if httpd:
+            httpd.shutdown()
+            httpd.server_close()
         # sever live keep-alive connections: their handler threads
         # would otherwise keep serving this "stopped" node (a process
         # kill severs them for free; in-process stop must match)
@@ -693,19 +726,20 @@ class _ShardSearchBatcher:
         self._thread: threading.Thread | None = None
 
     def submit(self, q: str, topk: int, lang: int,
-               timeout: float,
-               parent_span=None) -> dict | None:
+               timeout: float, parent_span=None,
+               deadline=None) -> dict | None:
         holder = {"done": False, "out": None}
         with self._cv:
-            self._queue.append(((topk, lang), q, holder, parent_span))
+            self._queue.append(((topk, lang), q, holder, parent_span,
+                                deadline))
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threads.spawn(
                     f"shard{self.shard}-qbatch", self._run)
             self._cv.notify_all()
-        deadline = time.monotonic() + timeout + 5.0
+        wait_dl = deadline_mod.Deadline.after(timeout + 5.0)
         with self._cv:
             while not holder["done"]:
-                left = deadline - time.monotonic()
+                left = wait_dl.remaining()
                 if left <= 0:
                     break
                 self._cv.wait(left)
@@ -737,19 +771,26 @@ class _ShardSearchBatcher:
 
     def _issue(self, key: tuple, batch: list) -> None:
         topk, lang = key
-        qs = [q for _, q, _, _ in batch]
+        qs = [q for _, q, _, _, _ in batch]
         # the batcher runs in its own thread (empty contextvars
         # context); re-attach the first waiter's span so the coalesced
         # RPC lands in SOME trace, and give every other waiter a
         # completed "coalesced" marker span covering the same interval
-        parents = [p for _, _, _, p in batch if p is not None]
+        parents = [p for _, _, _, p, _ in batch if p is not None]
         primary = parents[0] if parents else None
+        # the coalesced RPC carries the LONGEST rider budget — a
+        # short-deadline rider must not abandon every other rider's
+        # answer (its own coordinator still times out client-side)
+        dls = [d for _, _, _, _, d in batch if d is not None]
+        dl = max(dls, key=lambda d: d.at) if dls else None
         t0 = time.perf_counter()
-        with trace_mod.attach(primary):
+        with trace_mod.attach(primary), deadline_mod.bind(dl):
+            # span_parent rides along so the hedged read's per-attempt
+            # spans (hedge fired/won) land in the primary rider's trace
             out = self.client._read_shard(
                 self.shard, "/rpc/search",
                 {"queries": qs, "topk": topk, "lang": lang},
-                timeout=SEARCH_TIMEOUT_S)
+                timeout=SEARCH_TIMEOUT_S, span_parent=primary)
             results = out.get("results") if out else None
             if not isinstance(results, list) or len(results) != len(qs):
                 # old node (no batch support → 404 on "queries") or a
@@ -763,7 +804,7 @@ class _ShardSearchBatcher:
             p.record("rpc/search", t0, coalesced=True,
                      shard=self.shard, batch=len(qs))
         with self._cv:
-            for (_, _, holder, _), res in zip(batch, results):
+            for (_, _, holder, _, _), res in zip(batch, results):
                 holder["out"] = res
                 holder["done"] = True
             self._cv.notify_all()
@@ -899,6 +940,10 @@ class ClusterClient:
                 now = self._ping(s, r)
                 if now:
                     self.hostmap.mark_alive(s, r)
+                    # a ping answer drains fault penalty so a
+                    # recovered twin re-enters the read rotation
+                    # (reads alone can't fix an EWMA it never gets)
+                    self.hostmap.decay_rtt(s, r)
                 else:
                     self.hostmap.mark_dead(s, r)
                 if was != now:
@@ -1092,6 +1137,13 @@ class ClusterClient:
             r = order[i]
             if isinstance(err, transport_mod.NotOkError):
                 continue
+            if isinstance(err, transport_mod.RefusedError):
+                # actively refused the dial: known dead RIGHT NOW, not
+                # merely slow — no ping grace, out of rotation at once
+                # (the transport already penalized its EWMA)
+                self.hostmap.mark_dead(shard, r)
+                self.hostmap.penalize(shard, r, 1.0)
+                continue
             if self._ping(shard, r):
                 # alive but slow/failed on this request: penalize its
                 # load signal, keep it alive
@@ -1121,7 +1173,8 @@ class ClusterClient:
     # --- scatter-gather query (Msg3a) ------------------------------------
 
     def _search_shard(self, shard: int, q: str, topk: int,
-                      lang: int, parent_span=None) -> dict | None:
+                      lang: int, parent_span=None,
+                      deadline=None) -> dict | None:
         """One shard's leg of the scatter: rides the per-shard batcher
         so concurrent queries coalesce into one (hedged) RPC.
         ``parent_span`` carries the caller's trace across the
@@ -1140,7 +1193,8 @@ class ClusterClient:
             return out
         out = self._batchers[shard].submit(q, topk, lang,
                                            SEARCH_TIMEOUT_S,
-                                           parent_span=parent_span)
+                                           parent_span=parent_span,
+                                           deadline=deadline)
         if out is not None and out.get("ok", True):
             self._leg_cache.put(key, out, gen=gen)
         return out
@@ -1157,6 +1211,7 @@ class ClusterClient:
         order."""
         if not queries:
             return []
+        from ..query.engine import SearchResults
         with ThreadPoolExecutor(
                 max_workers=min(32, len(queries))) as ex:
             futs = [ex.submit(self.search, q, topk=topk, lang=lang,
@@ -1164,7 +1219,20 @@ class ClusterClient:
                               site_cluster=site_cluster,
                               offset=offset, conf=conf)
                     for q in queries]
-            return [f.result() for f in futs]
+            out = []
+            for q, f in zip(queries, futs):
+                try:
+                    out.append(f.result())
+                except Exception as e:  # noqa: BLE001 — one bad query
+                    # must not sink its batchmates: degrade to an
+                    # empty, uncacheable answer (same contract as a
+                    # timed-out scatter leg)
+                    log.warning("search_batch: %r failed: %s", q, e)
+                    g_stats.count("results.degraded")
+                    out.append(SearchResults(
+                        query=q, total_matches=0, results=[],
+                        degraded=True))
+            return out
 
     def search(self, q: str, topk: int = 10, lang: int = 0,
                with_snippets: bool = True, site_cluster: bool = True,
@@ -1203,13 +1271,14 @@ class ClusterClient:
 
         want = max(topk + offset, PQR_SCAN)
         over = max(want * 2, 16)
-        # the scatter span is handed to each leg explicitly: the legs
-        # run on read-pool threads, where the contextvar trace context
-        # does not follow
+        # the scatter span (and the query deadline) are handed to each
+        # leg explicitly: the legs run on read-pool threads, where
+        # contextvars do not follow
         scatter_sp = trace_mod.begin("scatter",
                                      shards=self.conf.n_shards)
+        dl = deadline_mod.current()
         futs = [self._read_pool.submit(
-            self._search_shard, s, q, over, lang, scatter_sp)
+            self._search_shard, s, q, over, lang, scatter_sp, dl)
             for s in range(self.conf.n_shards)]
         total = 0
         docids: list[int] = []
@@ -1230,6 +1299,12 @@ class ClusterClient:
             docids += [int(x) for x in as_array(out.get("docids", []))]
             scores += [float(x)
                        for x in as_array(out.get("scores", []))]
+        if degraded:
+            # normalized partial answer (shard down / leg timeout):
+            # stamped in stats, tagged in the trace, and the SERP is
+            # never cached (search() invalidates; the serve layer skips
+            # its page cache too)
+            g_stats.count("results.degraded")
         if scatter_sp is not None:
             scatter_sp.tag(degraded=degraded)
             scatter_sp.finish()

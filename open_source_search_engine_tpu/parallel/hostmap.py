@@ -124,6 +124,15 @@ class HostMap:
         not dead, but it should stop being the primary."""
         self.rtt_s[shard, replica] += dt_s
 
+    def decay_rtt(self, shard: int, replica: int,
+                  factor: float = 0.9) -> None:
+        """Shrink a twin's penalty toward zero — called for each twin
+        that answers a health ping, so penalty earned while it was dead
+        or wedged drains once it recovers instead of demoting it
+        forever (the EWMA only improves through reads it will never be
+        offered as long as it sorts last)."""
+        self.rtt_s[shard, replica] *= factor
+
     def twin_order(self, shard: int) -> list[int]:
         """Replicas of a shard in read-preference order: alive first,
         then fastest observed — the hedged read launches down this

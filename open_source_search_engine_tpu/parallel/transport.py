@@ -42,7 +42,8 @@ from typing import Callable
 
 import numpy as np
 
-from ..utils import threads, trace as trace_mod
+from ..utils import chaos as chaos_mod, deadline as deadline_mod, \
+    threads, trace as trace_mod
 from ..utils.lockcheck import make_lock
 from ..utils.log import get_logger
 from ..utils.stats import g_stats
@@ -80,6 +81,12 @@ class RpcError(Exception):
 class NotOkError(RpcError):
     """The peer ANSWERED, but the reply failed the acceptability check —
     a healthy host saying no (doc miss, refused op), not a sick one."""
+
+
+class RefusedError(RpcError):
+    """The peer actively refused the dial (RST, nothing listening) —
+    known-dead right now, not merely slow. Callers fast-fail: no ping
+    grace, twin demoted immediately (``transport.fastfail``)."""
 
 
 # ---------------------------------------------------------------------------
@@ -404,10 +411,17 @@ class Transport:
             headers["Accept"] = BIN_CONTENT_TYPE
         if sp is not None:
             headers[trace_mod.TRACE_HEADER] = trace_mod.header_for(sp)
+        dl = deadline_mod.current()
+        if dl is not None:
+            # budget, not an absolute clock — wall clocks don't agree
+            # across hosts (the node rebuilds a local Deadline from it)
+            headers[deadline_mod.DEADLINE_HEADER] = dl.header_value()
         t0 = time.monotonic()
         for attempt in (0, 1):
             conn, reused = self._checkout(addr, timeout)
             try:
+                if chaos_mod.g_chaos.enabled:
+                    chaos_mod.g_chaos.leg_fault(addr, path, timeout)
                 conn.request("POST", path, body=body, headers=headers)
                 resp = conn.getresponse()
                 data = resp.read()
@@ -422,6 +436,14 @@ class Transport:
             except Exception as e:  # noqa: BLE001 — timeout, refused, DNS
                 self._discard(conn)
                 g_stats.count("transport.error")
+                if isinstance(e, ConnectionRefusedError):
+                    # dead-peer fast-fail: demote the twin's load signal
+                    # NOW instead of letting a refused dial wait out the
+                    # EWMA clamp, and raise typed so the layer above can
+                    # skip the ping grace a merely-slow host gets
+                    g_stats.count("transport.fastfail")
+                    self.penalize(addr, path, 1.0)
+                    raise RefusedError(f"{addr}{path}: {e!r}") from e
                 raise RpcError(f"{addr}{path}: {e!r}") from e
             if resp.will_close:
                 self._discard(conn)
@@ -476,7 +498,10 @@ class Transport:
             is_ok = lambda o: bool(o.get("ok")) or "total" in o
         parent = span_parent if span_parent is not None else \
             trace_mod.current_span()
-        deadline = time.monotonic() + timeout
+        dl = deadline_mod.current()
+        deadline = deadline_mod.Deadline.after(timeout)
+        if dl is not None and dl.at < deadline.at:
+            deadline = dl  # the query budget runs out first
         cv = threading.Condition()
         #: per attempt: None = in flight, ("ok", out) or ("err", e)
         state: list = [None] * len(addrs)
@@ -490,9 +515,10 @@ class Transport:
                 # span= only when tracing: tests monkeypatch request()
                 # with the plain 5-arg signature
                 kw = {} if spans[i] is None else {"span": spans[i]}
-                out = self.request(addrs[i], path, payload,
-                                   timeout=timeout, niceness=niceness,
-                                   **kw)
+                with deadline_mod.bind(dl):
+                    out = self.request(addrs[i], path, payload,
+                                       timeout=timeout,
+                                       niceness=niceness, **kw)
                 res = ("ok", out) if is_ok(out) else \
                     ("err", NotOkError(f"{addrs[i]}{path}: not ok"))
             except Exception as e:  # noqa: BLE001
@@ -530,9 +556,9 @@ class Transport:
                                if not launched[i]), None)
                 now = time.monotonic()
                 if next_i is None:
-                    if not in_flight or now >= deadline:
+                    if not in_flight or deadline.expired():
                         break  # every attempt failed (or clock ran out)
-                    cv.wait(min(deadline - now, 0.5))
+                    cv.wait(min(deadline.remaining(), 0.5))
                     continue
                 if not in_flight:
                     # previous attempt(s) failed outright — immediate
@@ -556,7 +582,8 @@ class Transport:
                         self.penalize(addrs[i], path, now - launch_t[i])
                     launch(next_i, hedge=True)
                     continue
-                cv.wait(min(fire_at - now, max(deadline - now, 0.0)))
+                cv.wait(min(fire_at - now,
+                            max(deadline.remaining(), 0.0)))
         if winner >= 0 and hedge_launch[winner]:
             g_stats.count("transport.hedge_won")
         if winner >= 0 and spans[winner] is not None:

@@ -20,6 +20,7 @@ import numpy as np
 
 from ..build import docproc
 from ..index.collection import Collection
+from ..utils import deadline as deadline_mod
 from ..utils import trace
 from ..utils.log import get_logger
 from ..utils.stats import g_stats
@@ -454,11 +455,17 @@ def search_device_batch(coll: Collection, queries, *, topk: int = 10,
              for q in queries]
     g_stats.count("query", len(plans))
     ktot = max((topk + offset) * 2, 64)
+    if deadline_mod.check_abandon("device.dispatch"):
+        # the coordinator timed out while this batch queued — abandon
+        # before the device wave, not after it
+        raise deadline_mod.DeadlineExceeded(
+            "deadline exceeded before device dispatch")
     if resident:
         loop = get_resident_loop(coll)
         with trace.timed_span("query.device_batch", queries=len(plans),
                               topk=ktot, resident=True):
-            ticket = loop.submit(plans, topk=ktot, lang=lang)
+            ticket = loop.submit(plans, topk=ktot, lang=lang,
+                                 deadline=deadline_mod.current())
             raw = ticket.wait()
         di = ticket.di  # the index the wave actually ran against
     else:

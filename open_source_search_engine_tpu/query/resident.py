@@ -35,7 +35,9 @@ import time
 from collections import deque
 from typing import Callable
 
+from ..utils import deadline as deadline_mod
 from ..utils import threads as _threads
+from ..utils.chaos import g_chaos
 from ..utils.log import get_logger
 from ..utils.stats import g_stats
 
@@ -60,13 +62,14 @@ class Ticket:
     callers use ``di`` for post-processing (sitehash/langid lookups
     must come from the same snapshot that scored)."""
 
-    __slots__ = ("plans", "topk", "lang", "di", "generation",
-                 "_ev", "_res", "_err")
+    __slots__ = ("plans", "topk", "lang", "deadline", "di",
+                 "generation", "_ev", "_res", "_err")
 
-    def __init__(self, plans, topk: int, lang: int):
+    def __init__(self, plans, topk: int, lang: int, deadline=None):
         self.plans = plans
         self.topk = topk
         self.lang = lang
+        self.deadline = deadline
         self.di = None
         self.generation: int | None = None
         self._ev = threading.Event()
@@ -129,10 +132,13 @@ class ResidentLoop:
     def alive(self) -> bool:
         return self._alive and self._thread.is_alive()
 
-    def submit(self, plans, *, topk: int = 64, lang: int = 0) -> Ticket:
+    def submit(self, plans, *, topk: int = 64, lang: int = 0,
+               deadline: deadline_mod.Deadline | None = None) -> Ticket:
         """Enqueue compiled plans; returns immediately. The hot path is
-        a list append + notify — no device work on this thread."""
-        t = Ticket(list(plans), topk, lang)
+        a list append + notify — no device work on this thread. A
+        ``deadline`` rides the ticket: the loop abandons the wave before
+        issue if the budget ran out while the ticket queued."""
+        t = Ticket(list(plans), topk, lang, deadline)
         with self._cv:
             if not self._alive:
                 t._fail(RuntimeError("resident loop stopped"))
@@ -221,6 +227,20 @@ class ResidentLoop:
         batch = self._take_batch()
         if not batch:
             return
+        live = []
+        for t in batch:
+            # the coordinator's budget may have run out while the
+            # ticket queued — abandon before the device wave, not after
+            if deadline_mod.check_abandon("resident.issue", t.deadline):
+                t._fail(deadline_mod.DeadlineExceeded(
+                    "deadline exceeded before resident issue"))
+            else:
+                live.append(t)
+        batch = live
+        if not batch:
+            return
+        if g_chaos.enabled:
+            g_chaos.resident_fault("issue")
         try:
             di = self._index_for_issue()
             plans = [p for t in batch for p in t.plans]
@@ -239,6 +259,8 @@ class ResidentLoop:
     def _collect_one(self) -> None:
         wave = self._inflight.popleft()
         try:
+            if g_chaos.enabled:
+                g_chaos.resident_fault("collect")
             results = wave.di.collect_batch(wave.pending)
             off = 0
             for t in wave.tickets:

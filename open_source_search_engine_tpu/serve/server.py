@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import html as html_mod
 import json
+import os
 import threading
 import time
 import urllib.parse
@@ -32,6 +33,8 @@ from pathlib import Path
 from ..index.collection import CollectionDb
 from ..query import devcheck, engine
 from ..query.summary import highlight
+from ..utils import chaos as chaos_mod
+from ..utils import deadline as deadline_mod
 from ..utils import threads
 from ..utils.lockcheck import make_lock, make_rlock
 from ..utils.log import get_logger
@@ -39,6 +42,7 @@ from ..utils.membudget import g_membudget
 from ..utils import parms as parms_mod
 from ..utils import trace as trace_mod
 from ..utils.parms import Conf
+from ..utils.stats import g_stats
 from ..utils.trace import g_tracer
 
 log = get_logger("http")
@@ -88,14 +92,22 @@ class QueryBatcher:
 
     def search(self, key: tuple, q: str, timeout: float = 60.0):
         holder: dict = {}
+        # wait bounded by own timeout AND any bound query deadline —
+        # whichever is sooner (the hedged-transport merge rule)
+        dl = deadline_mod.current()
+        deadline = deadline_mod.Deadline.after(timeout)
+        if dl is not None and dl.at < deadline.at:
+            deadline = dl
         with self._cv:
             self._queue.append((key, q, holder,
-                                trace_mod.current_span()))
+                                trace_mod.current_span(), dl))
             self._cv.notify_all()
-            deadline = time.monotonic() + timeout
             while "res" not in holder and "err" not in holder:
-                left = deadline - time.monotonic()
+                left = deadline.remaining()
                 if left <= 0:
+                    if dl is not None and dl.expired():
+                        raise deadline_mod.DeadlineExceeded(
+                            "query deadline exceeded in batcher")
                     raise TimeoutError("query batcher timeout")
                 self._cv.wait(timeout=left)
         if "err" in holder:
@@ -134,8 +146,15 @@ class QueryBatcher:
             # with a completed "coalesced" marker covering the interval
             parents = [e[3] for e in batch if len(e) > 3 and
                        e[3] is not None]
+            # the coalesced dispatch runs under the LONGEST rider
+            # budget (a short-deadline rider must not abandon every
+            # other rider's wave; its own wait still times out)
+            dls = [e[4] for e in batch
+                   if len(e) > 4 and e[4] is not None]
+            dl = max(dls, key=lambda d: d.at) if dls else None
             t0 = time.perf_counter()
-            with trace_mod.attach(parents[0] if parents else None):
+            with trace_mod.attach(parents[0] if parents else None), \
+                    deadline_mod.bind(dl):
                 res = self._run_batch(key, [e[1] for e in batch])
             for p in parents[1:]:
                 p.record("query.device_batch", t0, coalesced=True,
@@ -526,6 +545,19 @@ class SearchHTTPServer:
             out = self._page_search_traced(query, q, debug, tr)
         return out
 
+    def _query_deadline(self, query: dict):
+        """The per-query budget: ``deadline_ms=`` on the request, else
+        the ``OSSE_DEADLINE_MS`` env default; absent/0 = unbudgeted."""
+        raw = query.get("deadline_ms", "") \
+            or os.environ.get("OSSE_DEADLINE_MS", "")
+        try:
+            ms = float(raw)
+        except (TypeError, ValueError):
+            return None
+        if ms <= 0:
+            return None
+        return deadline_mod.Deadline.after(ms / 1000.0)
+
     def _page_search_traced(self, query: dict, q: str, debug: bool,
                             tr) -> tuple[int, str, str]:
         n = min(int(query.get("n", 10)), 100)
@@ -534,12 +566,6 @@ class SearchHTTPServer:
         s = min(max(int(query.get("s", 0)), 0), 100000)
         fmt = query.get("format", "json")
         self.stats["queries"] += 1
-        # Msg17/Msg40Cache result cache: identical pages within the TTL
-        # serve from memory. Single-node, the LOCAL index version in
-        # the key invalidates instantly on mutation; the distributed
-        # planes (cluster/sharded) mutate on remote nodes this frontend
-        # can't version-watch, so there staleness is bounded by the TTL
-        # alone (the reference's Msg17 accepts the same bound).
         cname = query.get("c", "main")
         rc_coll = self._coll_read(query)
         ttl = float(getattr(rc_coll.conf, "result_cache_ttl", 0)
@@ -553,6 +579,43 @@ class SearchHTTPServer:
         if ttl > 0 and not debug:
             gen = self._result_gen(rc_coll)
             ckey = (cname, q, n, s, fmt)
+        dl = self._query_deadline(query)
+        try:
+            with deadline_mod.bind(dl):
+                out = self._search_cached(query, q, n, s, fmt, rc_coll,
+                                          debug, tr, ckey, gen, ttl,
+                                          swr)
+            deadline_mod.note_met(dl)
+            return out
+        except deadline_mod.DeadlineExceeded:
+            # budget burned downstream: the cache plane's just-expired
+            # answer (same generation — a write still invalidates)
+            # beats a refusal; it goes out marked degraded
+            if ckey is not None:
+                hit, page = self._result_cache.lookup_stale(ckey,
+                                                            gen=gen)
+                if hit:
+                    g_stats.count("deadline.stale_served")
+                    trace_mod.tag(deadline="expired",
+                                  results="degraded")
+                    self.stats["deadline_stale"] = \
+                        self.stats.get("deadline_stale", 0) + 1
+                    return page
+            g_stats.count("deadline.refused")
+            return 504, json.dumps({"error": "deadline exceeded"}), \
+                "application/json"
+
+    def _search_cached(self, query: dict, q: str, n: int, s: int,
+                       fmt: str, rc_coll, debug: bool, tr, ckey, gen,
+                       ttl: float, swr: float) -> tuple[int, str, str]:
+        # Msg17/Msg40Cache result cache: identical pages within the TTL
+        # serve from memory. Single-node, the LOCAL index version in
+        # the key invalidates instantly on mutation; the distributed
+        # planes (cluster/sharded) mutate on remote nodes this frontend
+        # can't version-watch, so there staleness is bounded by the TTL
+        # alone (the reference's Msg17 accepts the same bound).
+        deg: dict = {}
+        if ckey is not None:
             hit, page = self._result_cache.lookup(ckey, gen=gen)
             if hit:
                 self.stats["result_cache_hits"] = \
@@ -568,16 +631,21 @@ class SearchHTTPServer:
                 page, status = self._result_cache.get_or_compute(
                     ckey,
                     lambda: self._render_search(query, q, n, s, fmt,
-                                                rc_coll, debug, tr),
+                                                rc_coll, debug, tr,
+                                                degraded_out=deg),
                     ttl_s=ttl, gen=gen, swr_s=swr)
                 if status in ("hit", "stale", "join"):
                     self.stats["result_cache_hits"] = \
                         self.stats.get("result_cache_hits", 0) + 1
                     trace_mod.tag(result_cache=status)
+                if deg.get("degraded"):
+                    # a degraded partial must not serve for a TTL as if
+                    # it were the full answer
+                    self._result_cache.invalidate(ckey)
                 return page
         page = self._render_search(query, q, n, s, fmt, rc_coll,
-                                   debug, tr)
-        if ckey is not None:
+                                   debug, tr, degraded_out=deg)
+        if ckey is not None and not deg.get("degraded"):
             self._result_cache.put(ckey, page, ttl_s=ttl, gen=gen)
         return page
 
@@ -596,7 +664,8 @@ class SearchHTTPServer:
                 rc_coll.posdb.version if rc_coll is not None else 0)
 
     def _render_search(self, query: dict, q: str, n: int, s: int,
-                       fmt: str, rc_coll, debug: bool, tr
+                       fmt: str, rc_coll, debug: bool, tr,
+                       degraded_out: dict | None = None
                        ) -> tuple[int, str, str]:
         if self.cluster is not None:
             # conf is only consulted for PQR factors — never create a
@@ -615,6 +684,8 @@ class SearchHTTPServer:
             try:
                 res = self._batcher.search(
                     (query.get("c", "main"), n, s), q)
+            except deadline_mod.DeadlineExceeded:
+                raise  # serve edge owns expiry (stale-or-504)
             except Exception as e:  # noqa: BLE001 — degrade, don't 500
                 log.warning("device search failed (%s); host fallback",
                             e)
@@ -625,6 +696,13 @@ class SearchHTTPServer:
             with self._lock:
                 res = engine.search(self._coll(query), q, topk=n,
                                     offset=s)
+        if getattr(res, "degraded", False):
+            # a scatter leg timed out / failed past the hedge: partial
+            # answer, stamped so the caller skips the result cache
+            if degraded_out is not None:
+                degraded_out["degraded"] = True
+            self.stats["degraded"] = self.stats.get("degraded", 0) + 1
+            trace_mod.tag(results="degraded")
         payload, ctype = render_results(
             res, fmt,
             trace_id=tr.trace_id if (debug and tr is not None) else None)
@@ -1190,6 +1268,7 @@ class SearchHTTPServer:
     def start(self) -> None:
         from ..utils import jitwatch
         jitwatch.maybe_enable()
+        chaos_mod.maybe_enable()  # OSSE_CHAOS=<seed> arms the plane
         outer = self
 
         class Handler(BaseHTTPRequestHandler):

@@ -1,0 +1,279 @@
+"""Chaos plane — deterministic, seedable fault injection.
+
+The reference engine's defining property was survival: years of
+crawl/index/serve across flaky hosts, with twin failover, Rdb CRC
+quarantine, and OOM-deferred merges absorbing the failures. We own the
+same planes (hedged transport, cache shedding, scrub, the resident
+loop) — this module is how we *prove* they compose, by injecting the
+ancestral faults on demand:
+
+==================  =====================================================
+injection point     faults (Gigablast ancestor)
+==================  =====================================================
+transport.request   drop / delay / refuse / blackhole a scatter leg
+                    (dead host in the Msg39 scatter)
+cluster.node        kill / wedge / slowwalk a shard node mid-query
+                    (the wedged-twin EWMA case)
+rdb.read            flip bytes in a posting run on disk so CRC verify /
+                    scrub must trip (corrupt RdbMap)
+membudget.reserve   force a pressure pass so caches shed before work is
+                    refused (the OOM merge defer)
+resident.loop       stall a wave / drop a collect
+==================  =====================================================
+
+Arming: ``OSSE_CHAOS=<seed>`` in the environment (``maybe_enable`` at
+import of the device layer and the servers), or ``g_chaos.enable(seed)``
+programmatically. Off is a **true no-op** exactly like jitwatch: the
+only cost on a hot path is one attribute check (``g_chaos.enabled``) —
+every seam guards its call with that flag.
+
+Determinism: a decision is a pure function of ``(seed, point name,
+per-point call index)`` via sha256 — no shared RNG stream, so the same
+seed and the same per-point call sequence replay the same fault
+schedule regardless of how threads interleave *across* points. Every
+fired fault counts under ``chaos.<point>.<kind>`` in g_stats.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+
+from .lockcheck import make_lock
+from .log import get_logger
+from .stats import g_stats
+
+log = get_logger("chaos")
+
+
+class ChaosError(RuntimeError):
+    """An injected fault — distinguishable from a real one in tests and
+    telemetry, and handled by the same recovery paths."""
+
+
+#: point name → fault kinds it can fire (the registry; rates start at 0
+#: until enable() arms them)
+DEFAULT_POINTS: dict[str, tuple[str, ...]] = {
+    "transport.request": ("drop", "delay", "refuse", "blackhole"),
+    "cluster.node": ("slowwalk", "wedge", "kill"),
+    "rdb.read": ("flipbyte",),
+    "membudget.reserve": ("pressure",),
+    "resident.loop": ("stall", "drop_collect"),
+}
+
+
+class _Point:
+    __slots__ = ("name", "kinds", "rate", "match", "delay_s", "calls",
+                 "fired")
+
+    def __init__(self, name: str, kinds: tuple[str, ...]):
+        self.name = name
+        self.kinds = kinds
+        self.rate = 0.0
+        #: substring filter on the decide() key ("" matches everything)
+        self.match = ""
+        self.delay_s = 0.05
+        self.calls = 0
+        self.fired: dict[str, int] = {}
+
+
+class ChaosPlane:
+    """Singleton (:data:`g_chaos`). Inert until armed."""
+
+    def __init__(self):
+        self.enabled = False
+        self.seed: int | None = None
+        self._lock = make_lock("chaos.plane")
+        self._points: dict[str, _Point] = {}
+        self._fresh_points()
+
+    def _fresh_points(self) -> None:
+        self._points = {n: _Point(n, k) for n, k in
+                        DEFAULT_POINTS.items()}
+
+    # --- arming -----------------------------------------------------------
+
+    def enable(self, seed: int, rate: float = 0.1) -> None:
+        """Arm every point at ``rate``; idempotent re-arms reset the
+        per-point call counters so the schedule replays from the top."""
+        with self._lock:
+            self.seed = int(seed)
+            self._fresh_points()
+            for p in self._points.values():
+                p.rate = float(rate)
+            self.enabled = True
+        g_stats.gauge("chaos.enabled", 1)
+        log.info("chaos plane armed (seed=%d rate=%.3f)", seed, rate)
+
+    def disable(self) -> None:
+        with self._lock:
+            self.enabled = False
+            self.seed = None
+            self._fresh_points()
+        g_stats.gauge("chaos.enabled", 0)
+
+    def configure(self, point: str, rate: float | None = None,
+                  kinds: tuple[str, ...] | None = None,
+                  match: str | None = None,
+                  delay_s: float | None = None) -> None:
+        """Narrow one point: its fault rate, the kinds it may fire, a
+        substring the decide() key must contain (e.g. one twin's
+        ``host:port``), and the sleep used by delay-ish kinds. Tests
+        and the soak use this to aim faults."""
+        with self._lock:
+            p = self._points[point]
+            if rate is not None:
+                p.rate = float(rate)
+            if kinds is not None:
+                p.kinds = tuple(kinds)
+            if match is not None:
+                p.match = match
+            if delay_s is not None:
+                p.delay_s = float(delay_s)
+
+    def fired(self, point: str | None = None) -> dict:
+        """Per-kind fire counts (one point, or all points nested)."""
+        with self._lock:
+            if point is not None:
+                return dict(self._points[point].fired)
+            return {n: dict(p.fired) for n, p in self._points.items()}
+
+    # --- the decision function --------------------------------------------
+
+    def decide(self, point: str, key: str = "") -> str | None:
+        """None (no fault) or a fault kind. Pure in ``(seed, point,
+        call#)``: the hash — not shared RNG state — makes the schedule
+        replayable under threading."""
+        p = self._points.get(point)
+        if p is None or p.rate <= 0.0:
+            return None
+        with self._lock:
+            n = p.calls
+            p.calls += 1
+        if p.match and p.match not in key:
+            return None
+        h = hashlib.sha256(
+            f"{self.seed}:{point}:{n}".encode()).digest()
+        if int.from_bytes(h[:8], "big") / 2.0 ** 64 >= p.rate:
+            return None
+        kind = p.kinds[int.from_bytes(h[8:12], "big") % len(p.kinds)]
+        with self._lock:
+            p.fired[kind] = p.fired.get(kind, 0) + 1
+        g_stats.count(f"chaos.{point}.{kind}")
+        return kind
+
+    # --- seam helpers (each called only behind an `enabled` check) --------
+
+    def leg_fault(self, addr: str, path: str, timeout: float) -> None:
+        """transport.request: raise (drop/refuse/blackhole) or sleep
+        (delay) as if the wire did it. Refusal raises a real
+        ConnectionRefusedError so the transport's fast-fail path is the
+        one exercised."""
+        kind = self.decide("transport.request", key=f"{addr}{path}")
+        if kind is None:
+            return
+        p = self._points["transport.request"]
+        if kind == "delay":
+            time.sleep(p.delay_s)
+            return
+        if kind == "refuse":
+            raise ConnectionRefusedError(
+                f"chaos: refused {addr}{path}")
+        if kind == "blackhole":
+            # the worst dead-host mode: silence, then failure — held to
+            # a bounded slice of the leg timeout so tests stay fast
+            time.sleep(min(float(timeout), p.delay_s * 10.0))
+        raise ChaosError(f"chaos: {kind} {addr}{path}")
+
+    def node_fault(self, node) -> None:
+        """cluster.node: slow-walk (small sleep), wedge (long sleep),
+        or kill (stop the server from a side thread; the in-flight
+        reply is severed and the client's hedge eats it)."""
+        kind = self.decide("cluster.node",
+                           key=str(getattr(node, "port", "")))
+        if kind is None:
+            return
+        p = self._points["cluster.node"]
+        if kind == "slowwalk":
+            time.sleep(p.delay_s)
+            return
+        if kind == "wedge":
+            time.sleep(p.delay_s * 20.0)
+            return
+        from . import threads
+        threads.spawn("chaos-kill", node.stop)
+        # hold the in-flight reply past the hedge leash: a kill is not
+        # a clean error — the socket goes silent, and the client's
+        # hedge (not an instant error-failover) is what must eat it
+        time.sleep(p.delay_s * 10.0)
+        raise ChaosError("chaos: node killed mid-query")
+
+    def rdb_fault(self, rdb) -> None:
+        """rdb.read: corrupt one on-disk run so the CRC planes (load
+        verify / scrub) must trip before those bytes are trusted
+        again."""
+        if self.decide("rdb.read",
+                       key=getattr(rdb, "name", "")) == "flipbyte":
+            self.corrupt_one_run(rdb)
+
+    def corrupt_one_run(self, rdb) -> str | None:
+        """Flip one byte of one loaded run on disk (deterministic pick
+        from the seed). Returns the path touched, or None when the rdb
+        has no on-disk runs. The scrub/verify plane — not this — is
+        responsible for noticing."""
+        runs = [r for r in getattr(rdb, "runs", [])
+                if getattr(r, "path", None) is not None]
+        if not runs:
+            return None
+        h = hashlib.sha256(
+            f"{self.seed}:flip:{len(runs)}".encode()).digest()
+        run = runs[int.from_bytes(h[:4], "big") % len(runs)]
+        fname = "data.npy" if run.data is not None else "keys.npy"
+        target = run.path / fname
+        size = os.path.getsize(target)
+        if size < 256:
+            return None
+        # stay past the .npy header; flip mid-payload
+        off = 192 + int.from_bytes(h[4:8], "big") % (size - 256)
+        with open(target, "r+b") as f:
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0xFF]))
+        g_stats.count("chaos.rdb.corrupted")
+        log.info("chaos: flipped byte %d of %s", off, target)
+        return str(target)
+
+    def resident_fault(self, where: str) -> None:
+        """resident.loop: stall an issue/collect, or drop a collect
+        (raises; the loop fails that wave's tickets and the layer above
+        — hedge, retry — recovers)."""
+        kind = self.decide("resident.loop", key=where)
+        if kind is None:
+            return
+        if kind == "stall":
+            time.sleep(self._points["resident.loop"].delay_s)
+            return
+        if where == "collect":
+            raise ChaosError("chaos: collect dropped")
+
+
+#: process-wide plane (jitwatch-style: module import costs nothing,
+#: arming is explicit)
+g_chaos = ChaosPlane()
+
+
+def maybe_enable() -> bool:
+    """Arm from ``OSSE_CHAOS=<seed>`` if set (call at server startup —
+    never on a hot path). Returns True when armed."""
+    v = os.environ.get("OSSE_CHAOS", "")
+    if not v:
+        return False
+    try:
+        seed = int(v)
+    except ValueError:
+        log.warning("OSSE_CHAOS=%r is not an integer seed; ignored", v)
+        return False
+    g_chaos.enable(seed)
+    return True

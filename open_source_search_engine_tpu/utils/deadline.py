@@ -1,0 +1,133 @@
+"""Per-query deadline propagation — the ``Deadline`` helper.
+
+The coordinator gives every query one time budget at the serve edge.
+That budget must travel with the work: across the scatter fan-out, over
+the wire to shard nodes, and down to the device dispatch — so a shard
+abandons work the coordinator has already timed out instead of burning
+a device wave on an answer nobody is waiting for.
+
+Mechanics:
+
+* A :class:`Deadline` is one monotonic point in time. It crosses
+  threads explicitly via :class:`bind` (contextvars don't follow pool
+  threads) and crosses hosts as **remaining budget** in the
+  ``X-OSSE-Deadline`` header — wall clocks don't agree between hosts,
+  budgets do (the gRPC deadline-propagation trick).
+* Checkpoints call :func:`check_abandon` — at node dequeue
+  (``ShardNodeServer.do_POST``), before device dispatch
+  (``engine.search_device_batch`` / the resident loop's issue step) —
+  which counts ``deadline.abandoned`` and tags the active trace span.
+* :func:`note_met` counts ``deadline.met`` where a query finishes
+  inside its budget.
+
+The osselint ``bare-deadline`` rule fences this module in: raw
+``time.monotonic() + timeout`` arithmetic on query/parallel/serve paths
+must come through here, so the header stamping and the abandon
+counters can never be bypassed by one more hand-rolled deadline.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+
+from . import trace as trace_mod
+from .stats import g_stats
+
+#: wire header carrying the remaining budget (decimal seconds) on
+#: scatter legs
+DEADLINE_HEADER = "X-OSSE-Deadline"
+
+
+class DeadlineExceeded(RuntimeError):
+    """Work was abandoned because the coordinator's deadline passed."""
+
+
+class Deadline:
+    """One monotonic instant work must finish by."""
+
+    __slots__ = ("at",)
+
+    def __init__(self, at_monotonic: float):
+        self.at = float(at_monotonic)
+
+    @classmethod
+    def after(cls, budget_s: float) -> "Deadline":
+        return cls(time.monotonic() + float(budget_s))
+
+    def remaining(self) -> float:
+        return self.at - time.monotonic()
+
+    def expired(self) -> bool:
+        return self.at - time.monotonic() <= 0.0
+
+    def clamp(self, timeout_s: float) -> float:
+        """A sub-call timeout bounded by what's left of the budget
+        (floored at 0 — callers treat 0 as already-too-late)."""
+        return max(0.0, min(float(timeout_s), self.remaining()))
+
+    def header_value(self) -> str:
+        return f"{max(self.remaining(), 0.0):.4f}"
+
+    @classmethod
+    def from_header(cls, value: str | None) -> "Deadline | None":
+        if not value:
+            return None
+        try:
+            return cls.after(float(value))
+        except (TypeError, ValueError):
+            return None
+
+    def __repr__(self) -> str:  # noqa: D105
+        return f"Deadline(remaining={self.remaining():.3f}s)"
+
+
+_ctx: contextvars.ContextVar[Deadline | None] = contextvars.ContextVar(
+    "osse_deadline", default=None)
+
+
+def current() -> Deadline | None:
+    """The deadline bound in this context (None = unbudgeted work)."""
+    return _ctx.get()
+
+
+class bind:
+    """Carry a Deadline across a scope. Worker threads don't inherit
+    contextvars — capture ``current()`` where the deadline is known and
+    ``bind()`` it where the work actually runs (the trace plane's
+    ``attach`` pattern)."""
+
+    def __init__(self, dl: Deadline | None):
+        self._dl = dl
+        self._tok = None
+
+    def __enter__(self) -> Deadline | None:
+        self._tok = _ctx.set(self._dl)
+        return self._dl
+
+    def __exit__(self, *exc) -> bool:
+        _ctx.reset(self._tok)
+        return False
+
+
+def check_abandon(where: str, dl: Deadline | None = None) -> bool:
+    """True when the (given or current) deadline has passed — the
+    caller abandons. Counts ``deadline.abandoned`` (plus a per-site
+    counter) and tags the active trace span so abandoned work shows in
+    query waterfalls."""
+    if dl is None:
+        dl = _ctx.get()
+    if dl is None or not dl.expired():
+        return False
+    g_stats.count("deadline.abandoned")
+    g_stats.count(f"deadline.abandoned.{where}")
+    trace_mod.tag(deadline="abandoned", deadline_where=where)
+    return True
+
+
+def note_met(dl: Deadline | None = None) -> None:
+    """Count a budgeted query that finished inside its budget."""
+    if dl is None:
+        dl = _ctx.get()
+    if dl is not None and not dl.expired():
+        g_stats.count("deadline.met")

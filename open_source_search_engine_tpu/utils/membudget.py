@@ -111,6 +111,12 @@ class MemBudget:
         nbytes = int(nbytes)
         if nbytes <= 0:
             return True
+        from .chaos import g_chaos
+        if g_chaos.enabled and \
+                g_chaos.decide("membudget.reserve", key=label):
+            # forced pressure: the shed-before-refuse path must run
+            # even when the budget would have fit
+            self._relieve(nbytes)
         with self._lock:
             fits = self._used_locked() + nbytes <= self.limit
         if not fits:

@@ -64,3 +64,14 @@ def waived_sleep():
     import time
     with _lock:
         time.sleep(0)  # osselint: ignore[blocking-under-lock] — test fixture
+
+
+def budgeted_wait(timeout):
+    # deadlines through the helper; now - t0 durations stay legal
+    import time
+    from ..utils.deadline import Deadline
+    dl = Deadline.after(timeout)
+    t0 = time.monotonic()
+    while not dl.expired() and dl.remaining() > 0:
+        break
+    return time.monotonic() - t0

@@ -68,3 +68,9 @@ def register_peer(name):
 def pull_scores(x):
     import jax
     return jax.device_get(x)  # EXPECT device-sync
+
+
+def hand_rolled_deadline(timeout):
+    deadline = time.time() + timeout  # EXPECT bare-deadline
+    left = deadline - time.monotonic()  # EXPECT bare-deadline
+    return left

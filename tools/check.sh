@@ -27,9 +27,11 @@ if [ "${1:-}" = "--lint-only" ]; then
     exit 0
 fi
 
-# 3. fast tier-1 slice: the lint gate, the jit plane, and the query
-#    stack (the layers a typical PR touches)
+# 3. fast tier-1 slice: the lint gate, the jit plane, the query
+#    stack (the layers a typical PR touches), and the seeded chaos
+#    smoke — deterministic fault schedules, deadline propagation, twin
+#    failover; the full soak gate stays behind `-m slow` / BENCH_SOAK=1
 JAX_PLATFORMS=cpu python -m pytest tests/test_lint.py \
-    tests/test_jitwatch.py tests/test_query.py -q -m 'not slow' \
-    -p no:cacheprovider
+    tests/test_jitwatch.py tests/test_query.py tests/test_chaos.py \
+    -q -m 'not slow' -p no:cacheprovider
 echo "check.sh: OK"

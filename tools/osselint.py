@@ -54,6 +54,12 @@ plan):
   device-boundary modules (devindex, scorer, sharded): an implicit
   device→host sync on the request path, exactly the hidden
   serialization the resident loop exists to avoid.
+* ``bare-deadline`` — raw ``time.monotonic() + timeout`` /
+  ``x - time.monotonic()`` deadline math on the query/parallel/serve
+  paths: a hand-rolled deadline never stamps ``X-OSSE-Deadline`` onto
+  scatter legs and never feeds the ``deadline.abandoned`` counters —
+  use ``utils.deadline.Deadline`` (``.after``/``.remaining``/
+  ``.clamp``). ``now - t0`` duration measurement stays legal.
 
 Waive a finding with a trailing comment on its line::
 
@@ -902,6 +908,40 @@ def _jit_transfer_scope(rel: str) -> bool:
     return _in_pkg(rel) and rel not in _JIT_TRANSFER_BOUNDARY
 
 
+def rule_bare_deadline(ctx: Ctx) -> list[Finding]:
+    """Hand-rolled deadline arithmetic on the budgeted paths.
+
+    ``time.monotonic() + timeout`` mints a deadline no header stamps
+    and no abandon checkpoint sees; ``x - time.monotonic()`` is its
+    remaining-time read. Both must come through
+    ``utils.deadline.Deadline``. Duration measurement
+    (``time.monotonic() - t0``: the time call on the LEFT of the
+    subtraction) is not a deadline and stays legal."""
+    def is_now(expr: ast.AST) -> bool:
+        return (isinstance(expr, ast.Call)
+                and dotted(expr.func) in ("time.time",
+                                          "time.monotonic"))
+
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.BinOp):
+            continue
+        if isinstance(node.op, ast.Add) \
+                and (is_now(node.left) or is_now(node.right)):
+            what = "now + budget mints a deadline"
+        elif isinstance(node.op, ast.Sub) and is_now(node.right):
+            what = "x - now reads a hand-rolled deadline"
+        else:
+            continue
+        out.append(Finding(
+            ctx.rel, node.lineno, "bare-deadline",
+            f"{what} outside the Deadline helper — use "
+            "utils.deadline.Deadline (.after/.remaining/.clamp) so "
+            "the budget rides X-OSSE-Deadline and the "
+            "deadline.abandoned counters can't be bypassed"))
+    return out
+
+
 #: (rule-name, path predicate, checker)
 RULES = [
     ("ttlcache-offplane", _ttl_scope, rule_ttlcache_offplane),
@@ -920,6 +960,7 @@ RULES = [
     ("jit-donated-reuse", _in_pkg, rule_jit_donated_reuse),
     ("jit-implicit-transfer", _jit_transfer_scope,
      rule_jit_implicit_transfer),
+    ("bare-deadline", _timed_scope, rule_bare_deadline),
 ]
 
 RULE_NAMES = {name for name, _p, _c in RULES}
