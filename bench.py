@@ -1167,6 +1167,107 @@ def main_soak() -> dict:
     return rep
 
 
+def main_slo() -> dict:
+    """SLO gate (BENCH_SLO=1): a closed-loop query run on a 2-node
+    in-process cluster with ONE declared objective (query p99 <
+    BENCH_SLO_P99_MS). Scrapes ride the run at a fixed cadence and
+    feed the tracker the merged fleet stream. Exits 1 unless EVERY
+    gate holds: the merged fleet histogram is non-empty, the
+    burn-rate/budget math is finite, and total scrape time stays
+    under 1% of query wall time. Prints ONE JSON line; returns the
+    report."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import math
+    import random
+
+    from open_source_search_engine_tpu.parallel import cluster as cl
+    from open_source_search_engine_tpu.utils.slo import SloTracker
+    from open_source_search_engine_tpu.utils.stats import g_stats
+
+    g_stats.reset()
+    bdir = tempfile.mkdtemp(prefix="osse_bench_slo_")
+    n_docs = int(os.environ.get("BENCH_SLO_DOCS", "24"))
+    n_q = int(os.environ.get("BENCH_SLO_QUERIES", "400"))
+    p99_ms = float(os.environ.get("BENCH_SLO_P99_MS", "500"))
+    # two scrapes per run: the sampler's production cadence is one per
+    # 10s tick, so a sub-second closed loop gets mid-run + end-of-run
+    scrape_every = max(1, n_q // 2)
+    vocab = ("alpha bravo charlie delta echo foxtrot golf hotel "
+             "india juliet kilo lima").split()
+    nodes = []
+    for i in range(2):
+        node = cl.ShardNodeServer(os.path.join(bdir, f"n{i}"))
+        for d in range(n_docs):
+            words = " ".join(vocab[(d + j) % len(vocab)]
+                             for j in range(5))
+            node.handle("/rpc/index", {
+                "url": f"http://slo.test/{i}-{d}",
+                "content": (f"<html><body><p>{words} "
+                            f"token{d}</p></body></html>")})
+        node.start()
+        nodes.append(node)
+    conf = cl.HostsConf.parse(
+        "num-mirrors: 0\n"
+        + "\n".join(f"127.0.0.1:{n.port}" for n in nodes))
+    client = cl.ClusterClient(conf, use_heartbeat=False)
+
+    slo = SloTracker(registry=g_stats)
+    slo.declare_latency("query_p99", "cluster.query",
+                        threshold_ms=p99_ms, target=0.99)
+
+    rng = random.Random(6)
+    distinct = vocab + [f"token{d}" for d in range(n_docs)]
+    weights = [1.0 / (r + 1) ** 1.1 for r in range(len(distinct))]
+    # two-term queries: the pair space is large enough that most of
+    # the stream misses the result cache and pays a real scatter
+    stream = [" ".join(rng.choices(distinct, weights=weights, k=2))
+              for _ in range(n_q)]
+    for q in stream[:8]:  # absorb JAX compiles before the timed loop
+        client.search(q, topk=10)
+
+    fleet = None
+    scrape_s = 0.0
+    t0 = time.perf_counter()
+    for k, q in enumerate(stream):
+        client.search(q, topk=10)
+        if (k + 1) % scrape_every == 0:
+            s0 = time.perf_counter()
+            fleet = client.scrape()["fleet"]
+            scrape_s += time.perf_counter() - s0
+            slo.evaluate(fleet["counters"], fleet["latencies"])
+    wall = time.perf_counter() - t0
+
+    st = slo.status().get("query_p99", {})
+    hist = (fleet or {}).get("latencies", {}).get("cluster.query")
+    overhead = scrape_s / max(wall, 1e-9)
+    gates = {
+        "fleet_histogram_nonempty": (hist is not None
+                                     and hist.count > 0),
+        "burn_math_finite": (
+            math.isfinite(st.get("burn_rate", float("nan")))
+            and math.isfinite(st.get("budget_remaining",
+                                     float("nan")))),
+        "scrape_overhead_under_1pct": overhead < 0.01,
+    }
+    ok = all(gates.values())
+    rep = {
+        "metric": "slo_gate", "value": int(ok), "unit": "pass",
+        "ok": ok, "gates": gates, "queries": n_q,
+        "fleet_query_count": 0 if hist is None else hist.count,
+        "fleet_p99_ms": (0.0 if hist is None
+                         else round(hist.quantile(0.99), 2)),
+        "burn_rate": round(st.get("burn_rate", -1.0), 4),
+        "budget_remaining": round(st.get("budget_remaining", -1.0), 4),
+        "scrape_overhead_pct": round(100.0 * overhead, 3),
+        "wall_s": round(wall, 2),
+    }
+    print(json.dumps(rep))
+    client.close()
+    for n in nodes:
+        n.stop()
+    return rep
+
+
 if __name__ == "__main__":
     if os.environ.get("BENCH_SOAK"):
         sys.exit(0 if main_soak()["ok"] else 1)
@@ -1182,5 +1283,7 @@ if __name__ == "__main__":
         main_dispatch()
     elif os.environ.get("BENCH_JIT"):
         main_jit()
+    elif os.environ.get("BENCH_SLO"):
+        sys.exit(0 if main_slo()["ok"] else 1)
     else:
         main()

@@ -56,7 +56,7 @@ from ..utils import threads
 from ..utils import trace as trace_mod
 from ..utils.lockcheck import make_lock, make_rlock
 from ..utils.log import get_logger
-from ..utils.stats import g_stats
+from ..utils.stats import g_stats, merge_wire
 from . import transport as transport_mod
 from .hostmap import HostMap
 from .transport import BIN_CONTENT_TYPE, RpcError, Transport, as_array
@@ -69,6 +69,7 @@ RPC_TIMEOUT_S = 10.0
 #: twin (doubling work) and falsely mark slow-but-alive hosts dead
 SEARCH_TIMEOUT_S = 60.0
 PING_TIMEOUT_S = 1.5
+SCRAPE_TIMEOUT_S = 2.0
 RETRY_INTERVAL_S = 1.0
 HEARTBEAT_INTERVAL_S = 1.0
 
@@ -184,6 +185,11 @@ class ShardNodeServer:
             desc="per-shard /rpc/search replies (Msg39 result cache)")
         if not use_cache:
             self._search_cache.enabled = False
+        #: metrics registry served by /rpc/stats — the process-wide
+        #: g_stats by default; in-process multi-node tests inject a
+        #: private Stats per node so a scrape-merge is a real merge
+        #: instead of the singleton merged with itself
+        self.stats_registry = g_stats
 
     def _replay_journal(self) -> None:
         from ..build import docproc
@@ -226,6 +232,12 @@ class ShardNodeServer:
         if path == "/rpc/conf":
             # read-only conf dump (ops + broadcast verification)
             return {"ok": True, "conf": self.coll.conf.to_dict()}
+        if path == "/rpc/stats":
+            # lock-free like ping: a wedged writer must not blind the
+            # fleet scrape. Raw histogram buckets, not percentiles —
+            # the coordinator merges distributions (Tail at Scale).
+            return {"ok": True, "host": self.host, "port": self.port,
+                    "stats": self.stats_registry.wire()}
         if path == "/rpc/heal":
             # outside the writer lock: heal_from pulls for minutes and
             # takes the lock only for its atomic apply step — holding
@@ -918,6 +930,31 @@ class ClusterClient:
     def pending_writes(self) -> int:
         return sum(len(q) for q in self._queues.values())
 
+    # --- fleet metrics scrape (PagePerf-across-hosts) --------------------
+
+    def scrape(self, timeout: float = SCRAPE_TIMEOUT_S) -> dict:
+        """Pull ``/rpc/stats`` from every host and merge into the fleet
+        view. Returns ``{"hosts": {addr: wire|None}, "fleet":
+        {"counters", "latencies" (name -> LatencyStat), "gauges"}}`` —
+        fleet percentiles come from the merged histograms, never from
+        averaging per-host percentiles. Dead hosts appear as ``None``
+        in ``hosts`` and are simply absent from the merge (a scrape is
+        a read, not a liveness verdict)."""
+        addrs = [self.conf.addresses[s][r]
+                 for s in range(self.conf.n_shards)
+                 for r in range(self.conf.n_replicas)]
+        with trace_mod.timed_span("cluster.scrape", hosts=len(addrs)):
+            replies = self.transport.broadcast(
+                addrs, "/rpc/stats", {}, timeout)
+        hosts = {a: (r.get("stats") if r is not None and r.get("ok")
+                     else None)
+                 for a, r in replies.items()}
+        fleet = merge_wire([w for w in hosts.values() if w is not None])
+        g_stats.count("cluster.scrape")
+        g_stats.gauge("cluster.scrape_hosts_up",
+                      sum(1 for w in hosts.values() if w is not None))
+        return {"hosts": hosts, "fleet": fleet}
+
     # --- liveness (PingServer) -------------------------------------------
 
     def _ping(self, shard: int, replica: int) -> bool:
@@ -1251,10 +1288,15 @@ class ClusterClient:
             bool(conf.pqr_enabled), float(conf.pqr_lang_demote),
             float(conf.pqr_site_demote), float(conf.pqr_depth_demote))
         key = (q, topk, lang, with_snippets, site_cluster, offset, pqr)
-        out, _ = self._result_cache.get_or_compute(
-            key, lambda: self._search_uncached(
-                q, topk=topk, lang=lang, with_snippets=with_snippets,
-                site_cluster=site_cluster, offset=offset, conf=conf))
+        # the user-observed latency metric (cache hits included) — the
+        # histogram the query_p99 SLO reads
+        with trace_mod.timed_span("cluster.query"):
+            out, _ = self._result_cache.get_or_compute(
+                key, lambda: self._search_uncached(
+                    q, topk=topk, lang=lang,
+                    with_snippets=with_snippets,
+                    site_cluster=site_cluster, offset=offset,
+                    conf=conf))
         if getattr(out, "degraded", False):
             # a partial answer (shard down) must not be pinned for a
             # whole TTL — serve it once, recompute next time

@@ -474,6 +474,33 @@ class Transport:
                                resp.headers.get("Content-Type", ""))
         raise AssertionError("unreachable")
 
+    def broadcast(self, addrs: list[str], path: str, payload: dict,
+                  timeout: float, niceness: int = 1
+                  ) -> dict[str, dict | None]:
+        """The same request to EVERY address concurrently — the scrape
+        shape, not the race shape: no hedging, no winner, each peer's
+        answer (or ``None`` on failure) keyed by address. Pooled
+        connections are reused per-peer like any other RPC; background
+        niceness by default so a fleet scrape never contends with
+        query traffic."""
+        out: dict[str, dict | None] = {}
+        lock = threading.Lock()
+
+        def one(addr: str) -> None:
+            try:
+                res = self.request(addr, path, payload, timeout,
+                                   niceness=niceness)
+            except Exception:  # noqa: BLE001 — absent peer is a None
+                res = None
+            with lock:
+                out[addr] = res
+
+        ts = [threads.spawn(f"scrape-{a}", one, a) for a in addrs]
+        for t in ts:
+            t.join(timeout + 1.0)
+        with lock:
+            return {a: out.get(a) for a in addrs}
+
     # --- hedged fan-out ---------------------------------------------------
 
     def hedged(self, addrs: list[str], path: str, payload: dict,

@@ -1386,7 +1386,6 @@ class DeviceIndex:
         soon as the host args are enqueued — no host sync. This is the
         resident loop's steady-state dispatch cost (one enqueue), vs
         the full jit round trip a one-shot ``search_batch`` pays."""
-        from ..utils.stats import g_stats
         t_plan = time.perf_counter()
         qplans = [q if isinstance(q, QueryPlan) else compile_query(q, lang)
                   for q in queries]
@@ -1412,8 +1411,6 @@ class DeviceIndex:
             plans = [self.plan(qp, df_of=df_of, total_docs=total_docs,
                                sort_base_of=sort_base_of)
                      for qp in qplans]
-        g_stats.record_ms("devindex.plan",
-                          1000 * (time.perf_counter() - t_plan))
         trace.record("devindex.plan", t_plan, queries=len(qplans))
         live = [i for i, p in enumerate(plans) if p.matchable]
         results = [(np.empty(0, np.uint64), np.empty(0, np.float32), 0)
@@ -1495,7 +1492,6 @@ class DeviceIndex:
     def _issue_waves(self, plans, f1, f2, topk, k2v, f2_nsel, bmax):
         """Build + dispatch one round of waves — all async enqueues;
         the caller fetches every wave's output in ONE device_get."""
-        from ..utils.stats import g_stats
         t_issue = time.perf_counter()
         waves = []
         groups: dict[tuple[int, int], list[int]] = {}
@@ -1569,8 +1565,6 @@ class DeviceIndex:
                               self._run_batch_f2(
                                   [plans[i] for i in chunk],
                                   k2v, f2_nsel)))
-        g_stats.record_ms("devindex.issue",
-                          1000 * (time.perf_counter() - t_issue))
         trace.record("devindex.issue", t_issue, waves=len(waves))
         return waves
 
@@ -1578,26 +1572,23 @@ class DeviceIndex:
         """Fetch + parse every issued wave, re-issuing the (rare)
         escalation rungs inline until all queries emit — the ONE
         ``device_get`` per round is the only host sync on the path."""
-        from ..utils.stats import g_stats
         plans, results = pending.plans, pending.results
         waves, f2_nsel = pending.waves, pending.f2_nsel
         k_req = pending.k_req
         while waves:
             t_fetch = time.perf_counter()
             outs = jax.device_get([w[4] for w in waves])
-            g_stats.record_ms(
-                "devindex.wave_" + "+".join(sorted({w[0] for w in waves}))
-                + f"_n{len(waves)}",
-                1000 * (time.perf_counter() - t_fetch))
+            t_got = time.perf_counter()
+            kinds = "+".join(sorted({w[0] for w in waves}))
+            trace.record(f"devindex.wave_{kinds}_n{len(waves)}",
+                         t_fetch, t_got)
             # device-time attribution: device_get blocks until every
             # issued wave completes (the block_until_ready delta), so
             # this interval IS the device time of the round, and the
             # fetched buffers are the bytes moved device→host
             trace.record(
-                "devindex.device",
-                t_fetch,
-                kinds="+".join(sorted({w[0] for w in waves})),
-                waves=len(waves),
+                "devindex.device", t_fetch, t_got,
+                kinds=kinds, waves=len(waves),
                 bytes=int(sum(np.asarray(o).nbytes for o in outs)))
             f1_next: list[int] = []
             f2_next: list[int] = []

@@ -511,9 +511,7 @@ def search_device_batch(coll: Collection, queries, *, topk: int = 10,
                 suggestion=_suggest(coll, plan)
                 if n_matched == 0 else None,
                 facets=compute_facets(plan, docids, get_doc)))
-    g_stats.record_ms(
-        "query.results_batch",
-        1000 * (time.perf_counter() - t_res))
+    trace.record("query.results_batch", t_res, queries=len(out))
     return out
 
 

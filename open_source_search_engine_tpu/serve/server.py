@@ -464,6 +464,11 @@ class SearchHTTPServer:
             if path == "/delete":
                 return self._page_delete(query)
             return self._page_addurl(query)
+        if path == "/metrics":
+            # Prometheus-style exposition for EXTERNAL scrapers — like
+            # /search it is unauthenticated read-only plumbing, outside
+            # the /admin password gate
+            return 200, self._metrics_text(), "text/plain"
         if path.startswith("/admin") and not self._authorized(query):
             self.stats["auth_denied"] += 1
             return 401, json.dumps({"error": "bad or missing pwd"}), \
@@ -495,8 +500,7 @@ class SearchHTTPServer:
         if path == "/admin/hosts":
             return 200, self._page_hosts(), "application/json"
         if path == "/admin/perf":
-            from ..utils.stats import g_stats
-            return 200, json.dumps(g_stats.snapshot()), "application/json"
+            return self._page_perf(query)
         if path == "/admin/mem":
             return self._page_mem(query)
         if path == "/admin/transport":
@@ -542,7 +546,10 @@ class SearchHTTPServer:
         debug = query.get("debug", "") not in ("", "0")
         with g_tracer.start("search", sampled=True if debug else None,
                             q=q) as tr:
-            out = self._page_search_traced(query, q, debug, tr)
+            # the whole-request latency histogram (cache hits and
+            # degraded answers included) — what a single-node SLO reads
+            with trace_mod.timed_span("serve.search"):
+                out = self._page_search_traced(query, q, debug, tr)
         return out
 
     def _query_deadline(self, query: dict):
@@ -870,7 +877,7 @@ class SearchHTTPServer:
             f'<li><a href="/admin/{p}{sfx}">{p}</a></li>'
             for p in ("stats", "hosts", "perf", "mem", "transport",
                       "cache", "traces", "parms", "jit", "profiler",
-                      "graph"))
+                      "graph")) + '<li><a href="/metrics">metrics</a></li>'
         rows = "".join(f"<tr><td>{k}</td><td>{v}</td></tr>"
                        for k, v in self.stats.items())
         colls = ", ".join(self.colldb.names())
@@ -915,6 +922,183 @@ class SearchHTTPServer:
             f"<h2>guardrail counters</h2>"
             f"<table border=1>{crows}</table>"
             "</body></html>"), "text/html"
+
+    def _fleet_view(self) -> tuple[dict, dict]:
+        """(hosts, fleet): per-host ``Stats.wire()`` payloads (None for
+        an unreachable host) and their bucket-wise merge. A cluster
+        coordinator scrapes every node over ``/rpc/stats``; a
+        single-process server is a one-host fleet."""
+        from ..utils.stats import g_stats, merge_wire
+        if self.cluster is not None:
+            sc = self.cluster.scrape()
+            return sc["hosts"], sc["fleet"]
+        w = g_stats.wire()
+        return {"local": w}, merge_wire([w])
+
+    def _page_perf(self, query: dict) -> tuple[int, str, str]:
+        """Fleet perf dashboard (PagePerf drawn across hosts + the
+        PageStatsdb graphs): one row per latency metric with a p99
+        column per host and the MERGED fleet distribution — fleet
+        percentiles come from merged histogram buckets, never from
+        averaging per-host percentiles. The fleet p99 cell links its
+        exemplar trace to /admin/traces; SLO burn rates, gauges,
+        counters and qps/p50 sparklines ride below. ``?format=json``
+        returns the merged view raw."""
+        from ..utils.slo import g_slo
+        from ..utils.stats import LatencyStat, g_stats
+        hosts, fleet = self._fleet_view()
+        # evaluate against the view just scraped so the dashboard is
+        # fresh on demand rather than as stale as the last sampler tick
+        if g_slo.objectives:
+            try:
+                g_slo.evaluate(fleet["counters"], fleet["latencies"])
+            except Exception:
+                g_stats.count("slo.eval_errors")
+        slo_status = g_slo.status()
+        if query.get("format") == "json":
+            body = {
+                "hosts": {
+                    a: None if w is None else {
+                        k: LatencyStat.from_wire(v).to_dict()
+                        for k, v in w.get("latencies", {}).items()}
+                    for a, w in hosts.items()},
+                "fleet": {
+                    "counters": fleet["counters"],
+                    "gauges": fleet["gauges"],
+                    "latencies": {
+                        k: {**st.to_dict(),
+                            "exemplars": [
+                                {"trace_id": tid, "ms": ms}
+                                for _, (tid, ms)
+                                in sorted(st.exemplars.items())]}
+                        for k, st in fleet["latencies"].items()},
+                },
+                "slo": slo_status,
+            }
+            return 200, json.dumps(body), "application/json"
+
+        pwd = query.get("pwd", "")
+        sfx = f"&pwd={urllib.parse.quote(pwd)}" if pwd else ""
+        addrs = sorted(hosts)
+        per_host = {
+            a: {} if hosts[a] is None else {
+                k: LatencyStat.from_wire(v)
+                for k, v in hosts[a].get("latencies", {}).items()}
+            for a in addrs}
+        lat_rows = []
+        for name in sorted(fleet["latencies"]):
+            st = fleet["latencies"][name]
+            cells = "".join(
+                f"<td>{per_host[a][name].quantile(0.99):.2f}</td>"
+                if name in per_host[a] else "<td>-</td>"
+                for a in addrs)
+            d = st.to_dict()
+            ex = ""
+            if st.exemplars:
+                tid, _ms = st.exemplars[max(st.exemplars)]
+                ex = (f' <a href="/admin/traces?id={tid}{sfx}">'
+                      f"ex</a>")
+            lat_rows.append(
+                f"<tr><td>{name}</td>{cells}"
+                f"<td>{d['count']}</td><td>{d['avg_ms']:.2f}</td>"
+                f"<td>{d['p50_ms']:.2f}</td>"
+                f"<td>{d['p99_ms']:.2f}{ex}</td>"
+                f"<td>{d['max_ms']:.2f}</td></tr>")
+        hdr = "".join(f"<th>{a} p99</th>" for a in addrs)
+
+        def spark(metric: str, color: str) -> str:
+            pts = [(t, m.get(metric))
+                   for t, m in g_stats.series(last_s=600)
+                   if m.get(metric) is not None]
+            if len(pts) < 2:
+                return ""
+            t0, t1 = pts[0][0], pts[-1][0]
+            span = max(t1 - t0, 1.0)
+            top = max(v for _, v in pts) or 1.0
+            xy = " ".join(f"{(t - t0) / span * 120:.1f},"
+                          f"{28.0 - v / top * 24.0:.1f}"
+                          for t, v in pts)
+            return (f'<svg xmlns="http://www.w3.org/2000/svg" '
+                    f'width="124" height="30">'
+                    f'<polyline fill="none" stroke="{color}" '
+                    f'points="{xy}"/></svg> {metric} (max {top:g})')
+
+        slo_rows = "".join(
+            f"<tr><td>{n}</td><td>{st['kind']}</td>"
+            f"<td>{st['target']}</td>"
+            f"<td>{st['window_total']}</td><td>{st['window_bad']}</td>"
+            f"<td>{st['burn_rate']:.3f}</td>"
+            f"<td>{st['budget_remaining']:.3f}</td>"
+            f"<td>{'BURNING' if st['burning'] else 'ok'}</td></tr>"
+            for n, st in sorted(slo_status.items())) \
+            or "<tr><td colspan=8>no objectives declared</td></tr>"
+        gauge_rows = "".join(
+            f"<tr><td>{k}</td><td>{v:g}</td></tr>"
+            for k, v in sorted(fleet["gauges"].items()))
+        ctr_rows = "".join(
+            f"<tr><td>{k}</td><td>{v}</td></tr>"
+            for k, v in sorted(fleet["counters"].items()))
+        up = sum(1 for w in hosts.values() if w is not None)
+        return 200, (
+            "<html><head><title>gb perf</title></head><body>"
+            "<h1>fleet perf</h1>"
+            f"<p>{up}/{len(hosts)} hosts scraped &middot; "
+            f'<a href="/admin/perf?format=json{sfx}">json</a> &middot; '
+            f'<a href="/metrics">metrics</a></p>'
+            f"<p>{spark('qps', '#1f77b4')}<br>"
+            f"{spark('p50_ms', '#d62728')}</p>"
+            f"<h2>latencies (ms)</h2>"
+            f"<table border=1><tr><th>metric</th>{hdr}"
+            "<th>fleet n</th><th>avg</th><th>p50</th><th>p99</th>"
+            f"<th>max</th></tr>{''.join(lat_rows)}</table>"
+            "<h2>SLOs</h2>"
+            "<table border=1><tr><th>objective</th><th>kind</th>"
+            "<th>target</th><th>window n</th><th>bad</th>"
+            "<th>burn rate</th><th>budget left</th><th></th></tr>"
+            f"{slo_rows}</table>"
+            f"<h2>gauges</h2><table border=1>{gauge_rows}</table>"
+            f"<h2>counters</h2><table border=1>{ctr_rows}</table>"
+            "</body></html>"), "text/html"
+
+    def _metrics_text(self) -> str:
+        """Prometheus-style text exposition of the merged fleet view.
+        Histogram buckets carry OpenMetrics-style exemplar suffixes
+        (``# {trace_id="..."} <ms>``) where a sampled trace landed in
+        the bucket. Metric names ride in a ``name`` label so dotted
+        internal names pass through unmangled."""
+        from ..utils.stats import _bucket_bounds
+        hosts, fleet = self._fleet_view()
+        lines = [
+            "# HELP osse_latency_ms merged fleet latency histogram (ms)",
+            "# TYPE osse_latency_ms histogram",
+        ]
+        for name in sorted(fleet["latencies"]):
+            st = fleet["latencies"][name]
+            cum = 0
+            for idx in sorted(st.buckets):
+                cum += st.buckets[idx]
+                hi = _bucket_bounds(idx)[1]
+                line = (f'osse_latency_ms_bucket{{name="{name}",'
+                        f'le="{hi:g}"}} {cum}')
+                ex = st.exemplars.get(idx)
+                if ex is not None:
+                    line += f' # {{trace_id="{ex[0]}"}} {ex[1]:g}'
+                lines.append(line)
+            lines.append(f'osse_latency_ms_bucket{{name="{name}",'
+                         f'le="+Inf"}} {st.count}')
+            lines.append(f'osse_latency_ms_sum{{name="{name}"}} '
+                         f"{st.total_ms:g}")
+            lines.append(f'osse_latency_ms_count{{name="{name}"}} '
+                         f"{st.count}")
+        lines.append("# TYPE osse_counter counter")
+        lines.extend(f'osse_counter{{name="{k}"}} {v}'
+                     for k, v in sorted(fleet["counters"].items()))
+        lines.append("# TYPE osse_gauge gauge")
+        lines.extend(f'osse_gauge{{name="{k}"}} {v:g}'
+                     for k, v in sorted(fleet["gauges"].items()))
+        lines.append(f"osse_hosts_scraped "
+                     f"{sum(1 for w in hosts.values() if w is not None)}")
+        return "\n".join(lines) + "\n"
 
     def _page_transport(self, query: dict) -> tuple[int, str, str]:
         """Cluster transport health (the PagePerf slice of the
@@ -1214,6 +1398,20 @@ class SearchHTTPServer:
             g_stats.sample(qps=round(qps, 2),
                            p50_ms=round(snap.get("p50_ms", 0.0), 1),
                            budget_rejects=rejects, check_trips=trips)
+            # SLO tick: objectives consume the merged fleet stream on
+            # a coordinator, the local registry otherwise; a scrape
+            # failure costs one tick, never the sampler thread
+            try:
+                from ..utils.slo import g_slo
+                if g_slo.objectives:
+                    if self.cluster is not None:
+                        fl = self.cluster.scrape()["fleet"]
+                        g_slo.evaluate(fl["counters"],
+                                       fl["latencies"])
+                    else:
+                        g_slo.evaluate()
+            except Exception:  # noqa: BLE001 — keep sampling
+                g_stats.count("slo.eval_errors")
             try:
                 with open(self._statsdb_path, "a",
                           encoding="utf-8") as fh:
@@ -1269,6 +1467,16 @@ class SearchHTTPServer:
         from ..utils import jitwatch
         jitwatch.maybe_enable()
         chaos_mod.maybe_enable()  # OSSE_CHAOS=<seed> arms the plane
+        # the ROADMAP traffic-plane objective, declared by default so
+        # every server exports slo.query_p99.* from boot; operators
+        # can declare richer objectives before start()
+        from ..utils.slo import g_slo
+        if not g_slo.objectives:
+            g_slo.declare_latency(
+                "query_p99",
+                "cluster.query" if self.cluster is not None
+                else "serve.search",
+                threshold_ms=500.0, target=0.99)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):

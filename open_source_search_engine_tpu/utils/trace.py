@@ -49,7 +49,10 @@ import time
 import uuid
 from collections import deque
 
+from .log import get_logger
 from .stats import g_stats
+
+log = get_logger("perf")
 
 #: HTTP header carrying "<trace_id>:<parent_span_id>" across hosts
 TRACE_HEADER = "X-OSSE-Trace"
@@ -249,16 +252,29 @@ class timed_span:
 
     def __exit__(self, *exc) -> None:
         self._cm.__exit__(*exc)
-        g_stats.record_ms(self.name,
-                          (time.perf_counter() - self._t0) * 1000.0)
+        # exemplar: when this interval ran under a SAMPLED trace, pin
+        # its trace id to the histogram bucket it lands in — the
+        # /admin/perf p99 cell links to the concrete /admin/traces
+        # waterfall (Dapper's aggregate→trace bridge)
+        sp = self._cm.sp
+        g_stats.record_ms(
+            self.name, (time.perf_counter() - self._t0) * 1000.0,
+            exemplar=sp.trace_id if sp is not None else None)
 
 
 def record(name: str, t0: float, t1: float | None = None, **tags) -> None:
     """Attach an already-measured ``perf_counter`` interval to the
-    current span (after-the-fact device-time attribution)."""
+    current span AND to ``g_stats`` — like ``timed_span`` but for
+    intervals the caller timed itself (device-time attribution after a
+    block-until-ready). Feeding both planes here is what keeps ad-hoc
+    ``perf_counter`` deltas off the query path (the ``adhoc-timing``
+    lint rule)."""
+    end = time.perf_counter() if t1 is None else t1
     p = _ctx.get()
     if p is not None:
-        p.record(name, t0, t1, **tags)
+        p.record(name, t0, end, **tags)
+    g_stats.record_ms(name, (end - t0) * 1000.0,
+                      exemplar=p.trace_id if p is not None else None)
 
 
 def tag(**kw) -> None:

@@ -75,3 +75,15 @@ def budgeted_wait(timeout):
     while not dl.expired() and dl.remaining() > 0:
         break
     return time.monotonic() - t0
+
+
+def measured_interval(run):
+    # latency measurement through the dual-plane helpers: timed_span
+    # measures for you; trace.record attributes a self-timed interval
+    # (both feed g_stats AND the waterfall, so no adhoc-timing)
+    import time
+    with trace.timed_span("fixture.run"):
+        run()
+    t0 = time.perf_counter()
+    run()
+    trace.record("fixture.run2", t0)

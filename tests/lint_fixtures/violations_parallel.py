@@ -74,3 +74,9 @@ def hand_rolled_deadline(timeout):
     deadline = time.time() + timeout  # EXPECT bare-deadline
     left = deadline - time.monotonic()  # EXPECT bare-deadline
     return left
+
+
+def adhoc_latency(t0):
+    elapsed = time.perf_counter() - t0  # EXPECT adhoc-timing
+    wall_ms = 1000 * (time.time() - t0)  # EXPECT adhoc-timing
+    return elapsed, wall_ms

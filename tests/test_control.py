@@ -128,7 +128,8 @@ class TestParmsEndpoint:
                 f"{base}/admin/parms?langw=5.5"))
             assert r["updated"] == {"langw": "5.5"}
             assert r["coll"]["lang_weight"] == 5.5
-            r = json.load(urllib.request.urlopen(f"{base}/admin/perf"))
-            assert "counters" in r
+            r = json.load(urllib.request.urlopen(
+                f"{base}/admin/perf?format=json"))
+            assert "counters" in r["fleet"]
         finally:
             s.stop()

@@ -149,6 +149,31 @@ class TestSeededRegressions:
         assert osselint.check_source(
             src, "open_source_search_engine_tpu/utils/stats.py") == []
 
+    def test_adhoc_timing_on_query_path_is_caught(self):
+        # the literal devindex/engine shape the metrics-plane PR
+        # removed: a perf_counter delta feeding g_stats directly, so
+        # the interval never reaches the trace waterfall
+        src = ("import time\n"
+               "def collect(waves):\n"
+               "    t0 = time.perf_counter()\n"
+               "    out = fetch(waves)\n"
+               "    g_stats.record_ms('devindex.wave',\n"
+               "                      1000 * (time.perf_counter() - t0))\n"
+               "    return out\n")
+        found = osselint.check_source(
+            src, "open_source_search_engine_tpu/query/devindex.py")
+        assert [f.rule for f in found] == ["adhoc-timing"]
+        # the stats plane itself measures however it likes
+        assert osselint.check_source(
+            src, "open_source_search_engine_tpu/utils/stats.py") == []
+        # monotonic budget arithmetic is not latency measurement
+        mono = ("import time\n"
+                "def hedge_wait(t0):\n"
+                "    return time.monotonic() - t0\n")
+        assert osselint.check_source(
+            mono, "open_source_search_engine_tpu/parallel/cluster.py") \
+            == []
+
 
 class TestJitSeededRegressions:
     """The literal jit hazard shapes the PR 7 rules caught (or

@@ -33,5 +33,12 @@ fi
 #    failover; the full soak gate stays behind `-m slow` / BENCH_SOAK=1
 JAX_PLATFORMS=cpu python -m pytest tests/test_lint.py \
     tests/test_jitwatch.py tests/test_query.py tests/test_chaos.py \
+    tests/test_statsplane.py \
     -q -m 'not slow' -p no:cacheprovider
+
+# 4. SLO gate: 2-node fleet, mergeable-histogram scrape, burn-rate
+#    math; exits nonzero unless the merged histogram is populated,
+#    the burn math is finite, and scrape overhead stays under 1% of
+#    query wall time
+BENCH_SLO=1 JAX_PLATFORMS=cpu python bench.py
 echo "check.sh: OK"

@@ -60,6 +60,12 @@ plan):
   scatter legs and never feeds the ``deadline.abandoned`` counters —
   use ``utils.deadline.Deadline`` (``.after``/``.remaining``/
   ``.clamp``). ``now - t0`` duration measurement stays legal.
+* ``adhoc-timing`` — ``time.perf_counter() - t0`` /
+  ``time.time() - t0`` latency measurement on the query/parallel/serve
+  paths: the measured duration reaches neither the /admin/perf
+  histograms nor the trace waterfall (two-timing-planes drift) — use
+  ``trace.timed_span`` or ``trace.record``, which feed both.
+  ``time.monotonic() - t0`` budget arithmetic stays legal.
 
 Waive a finding with a trailing comment on its line::
 
@@ -942,6 +948,37 @@ def rule_bare_deadline(ctx: Ctx) -> list[Finding]:
     return out
 
 
+def rule_adhoc_timing(ctx: Ctx) -> list[Finding]:
+    """Ad-hoc latency measurement on the timed paths.
+
+    ``time.perf_counter() - t0`` (or ``time.time() - t0``) computes a
+    duration the aggregate plane and the trace plane never see — the
+    two-timing-planes-drift bug class: a latency that shows up in a
+    log line but not on /admin/perf, or vice versa. Measured intervals
+    come through ``trace.timed_span`` (measures for you) or
+    ``trace.record`` (attributes an interval you timed yourself) —
+    both feed g_stats AND the waterfall. ``time.monotonic() - t0``
+    stays legal: that is elapsed-budget arithmetic (deadlines,
+    backoff), not a latency measurement."""
+    def is_clock(expr: ast.AST) -> bool:
+        return (isinstance(expr, ast.Call)
+                and dotted(expr.func) in ("time.perf_counter",
+                                          "time.time"))
+
+    out = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub) \
+                and is_clock(node.left):
+            out.append(Finding(
+                ctx.rel, node.lineno, "adhoc-timing",
+                "ad-hoc clock delta measures a latency neither "
+                "/admin/perf nor the trace waterfall will see — use "
+                "trace.timed_span (or trace.record for an interval "
+                "you timed yourself); both feed g_stats AND the "
+                "trace plane"))
+    return out
+
+
 #: (rule-name, path predicate, checker)
 RULES = [
     ("ttlcache-offplane", _ttl_scope, rule_ttlcache_offplane),
@@ -961,6 +998,7 @@ RULES = [
     ("jit-implicit-transfer", _jit_transfer_scope,
      rule_jit_implicit_transfer),
     ("bare-deadline", _timed_scope, rule_bare_deadline),
+    ("adhoc-timing", _timed_scope, rule_adhoc_timing),
 ]
 
 RULE_NAMES = {name for name, _p, _c in RULES}
