@@ -1268,6 +1268,240 @@ def main_slo() -> dict:
     return rep
 
 
+def main_load() -> dict:
+    """Open-loop load gate (BENCH_LOAD=1): thousands of simulated
+    clients offer Poisson arrivals of a Zipf query mix to the serving
+    front door at swept rates — OPEN loop, so offered load does not
+    politely slow down when the server does (the closed-loop benches
+    can never create overload; this one exists to). Legs:
+
+    1. sweep BENCH_LOAD_QPS ascending → max sustained qps with fleet
+       p99 < BENCH_LOAD_P99_MS (from ``ClusterClient.scrape()``);
+    2. overload at BENCH_LOAD_OVER_X × the gate's measured capacity
+       (max_inflight / svc EWMA), with a mid-leg 2× burst: interactive
+       p99 must stay bounded while crawlbot traffic sheds, every shed
+       counted, and the admission queue must drain afterwards (no
+       metastable collapse);
+    3. recovery at the lowest sweep rate: p99 back under the SLO.
+
+    Chaos slow-walks every node (deterministic service-time floor) so
+    capacity is bounded by the admission plane, not scheduler noise.
+    Exits 1 unless EVERY gate holds. Prints ONE JSON line."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import random
+    import threading
+    from collections import Counter
+    from concurrent.futures import ThreadPoolExecutor
+
+    from open_source_search_engine_tpu.parallel import cluster as cl
+    from open_source_search_engine_tpu.serve import admission as adm
+    from open_source_search_engine_tpu.serve.server import \
+        SearchHTTPServer
+    from open_source_search_engine_tpu.utils.chaos import g_chaos
+    from open_source_search_engine_tpu.utils.stats import g_stats
+
+    g_stats.reset()
+    bdir = tempfile.mkdtemp(prefix="osse_bench_load_")
+    n_docs = int(os.environ.get("BENCH_LOAD_DOCS", "16"))
+    sweep = [float(x) for x in
+             os.environ.get("BENCH_LOAD_QPS", "8,16,32").split(",")]
+    leg_s = float(os.environ.get("BENCH_LOAD_SECONDS", "3"))
+    p99_ms = float(os.environ.get("BENCH_LOAD_P99_MS", "500"))
+    over_p99_ms = float(os.environ.get("BENCH_LOAD_OVER_P99_MS",
+                                       "1500"))
+    over_x = float(os.environ.get("BENCH_LOAD_OVER_X", "2"))
+    delay_ms = float(os.environ.get("BENCH_LOAD_DELAY_MS", "20"))
+    deadline_ms = float(os.environ.get("BENCH_LOAD_DEADLINE_MS",
+                                       "400"))
+    n_clients = int(os.environ.get("BENCH_LOAD_CLIENTS", "2000"))
+    workers = int(os.environ.get("BENCH_LOAD_WORKERS", "64"))
+
+    vocab = ("alpha bravo charlie delta echo foxtrot golf hotel "
+             "india juliet kilo lima").split()
+    nodes = []
+    for i in range(2):
+        node = cl.ShardNodeServer(os.path.join(bdir, f"n{i}"))
+        for d in range(n_docs):
+            words = " ".join(vocab[(d + j) % len(vocab)]
+                             for j in range(5))
+            node.handle("/rpc/index", {
+                "url": f"http://load.test/{i}-{d}",
+                "content": (f"<html><body><p>{words} "
+                            f"token{d}</p></body></html>")})
+        node.start()
+        nodes.append(node)
+    conf = cl.HostsConf.parse(
+        "num-mirrors: 0\n"
+        + "\n".join(f"127.0.0.1:{n.port}" for n in nodes))
+    client = cl.ClusterClient(conf, use_heartbeat=False)
+    srv = SearchHTTPServer(os.path.join(bdir, "front"),
+                           cluster=client)
+    # a tight, deterministic gate: capacity = max_inflight / svc time,
+    # so the harness can oversubscribe it on any machine
+    srv.admission = adm.AdmissionGate(max_inflight=2, max_queue=32)
+    if delay_ms > 0:
+        # chaos under offered load: slow-walk every node leg so the
+        # service-time floor (and therefore capacity) is deterministic
+        g_chaos.enable(11, rate=0.0)
+        g_chaos.configure("cluster.node", rate=1.0,
+                          kinds=("slowwalk",),
+                          delay_s=delay_ms / 1000.0)
+
+    rng = random.Random(6)
+    distinct = vocab + [f"token{d}" for d in range(n_docs)]
+    zipf_w = [1.0 / (r + 1) ** 1.1 for r in range(len(distinct))]
+    #: simulated client population: each has a sticky ip + tier
+    #: (60/10/30 interactive/suggest/crawlbot)
+    clients = [((f"10.{k >> 16 & 255}.{k >> 8 & 255}.{k & 255}"),
+                rng.choices(("interactive", "suggest", "crawlbot"),
+                            weights=(0.6, 0.1, 0.3))[0])
+               for k in range(1, n_clients + 1)]
+
+    for w in vocab[:8]:  # absorb JAX compiles before any timed leg
+        srv.handle("GET", "/search", {"q": w}, b"",
+                   client_ip="10.0.0.0")
+
+    pool = ThreadPoolExecutor(workers)
+    lock = threading.Lock()
+
+    def one(qstr: str, tier: str, ip: str, counts: Counter) -> None:
+        try:
+            code, _, _ = srv.handle(
+                "GET", "/search",
+                {"q": qstr, "tier": tier,
+                 "deadline_ms": str(deadline_ms)},
+                b"", client_ip=ip)
+        except Exception:  # noqa: BLE001 — a lost reply is the bug
+            code = -1
+        with lock:
+            counts[(tier, code)] += 1
+
+    def run_leg(qps: float, seconds: float,
+                burst_x: float = 1.0) -> dict:
+        g_stats.reset()
+        counts: Counter = Counter()
+        futs = []
+        t_start = time.monotonic()
+        end = t_start + seconds
+        b_lo = t_start + seconds / 3.0
+        b_hi = t_start + 2.0 * seconds / 3.0
+        t_next = t_start
+        arrivals = 0
+        while t_next < end:
+            now = time.monotonic()
+            if t_next > now:
+                time.sleep(t_next - now)
+            q = " ".join(rng.choices(distinct, weights=zipf_w, k=2))
+            ip, tier = clients[rng.randrange(n_clients)]
+            futs.append(pool.submit(one, q, tier, ip, counts))
+            arrivals += 1
+            rate = qps * (burst_x if b_lo <= t_next < b_hi else 1.0)
+            t_next += rng.expovariate(rate)
+        for f in futs:
+            f.result()
+        fleet = client.scrape()["fleet"]
+        # counters come from the LOCAL registry: in-process nodes share
+        # it, so the fleet merge double-counts front-door counters
+        counters = g_stats.snapshot()["counters"]
+
+        def p99(name: str) -> float:
+            h = fleet["latencies"].get(name)
+            return round(h.quantile(0.99), 2) if h is not None \
+                and h.count else 0.0
+
+        by_code: Counter = Counter()
+        by_tier_code: dict = {}
+        for (tier, code), n in counts.items():
+            by_code[code] += n
+            by_tier_code.setdefault(tier, Counter())[code] += n
+        return {
+            "offered_qps": round(qps, 1), "arrivals": arrivals,
+            "responses": sum(counts.values()),
+            "p99_ms": p99("serve.search"),
+            "tier_p99_ms": {t: p99(f"serve.search.{t}")
+                            for t in ("interactive", "suggest",
+                                      "crawlbot")},
+            "codes": {str(c): n for c, n in sorted(by_code.items())},
+            "tier_codes": {t: {str(c): n for c, n in sorted(v.items())}
+                           for t, v in sorted(by_tier_code.items())},
+            "shed_stale": counters.get("admission.shed.stale", 0),
+            "shed_refused": counters.get("admission.shed.refused", 0),
+            "queue_full": counters.get("admission.queue_full", 0),
+            "membudget_reject_serve": counters.get(
+                "membudget.reject.serve", 0),
+            "queue_delay_p99_ms": p99("admission.queue_delay"),
+        }
+
+    # --- leg 1: the sweep -------------------------------------------------
+    legs = []
+    max_sustained = 0.0
+    for qps in sweep:
+        leg = run_leg(qps, leg_s)
+        ok = (leg["p99_ms"] < p99_ms
+              and leg["responses"] == leg["arrivals"])
+        leg["sustained"] = ok
+        legs.append(leg)
+        if ok:
+            max_sustained = qps
+    sweep_hist_nonempty = any(leg["p99_ms"] > 0 for leg in legs)
+
+    # --- leg 2: overload (offered >> capacity, with a burst) --------------
+    snap = srv.admission.snapshot()
+    capacity = srv.admission.max_inflight / max(
+        snap["svc_ewma_ms"] / 1000.0, 1e-3)
+    over_qps = max(over_x * capacity, 2.0 * max(sweep))
+    over = run_leg(over_qps, leg_s, burst_x=2.0)
+    crawl_503 = over["tier_codes"].get("crawlbot", {}).get("503", 0)
+    crawl_shed = crawl_503 + over["shed_stale"]
+    drained = False
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 5.0:
+        if srv.admission.idle():
+            drained = True
+            break
+        time.sleep(0.02)
+
+    # --- leg 3: recovery --------------------------------------------------
+    recovery = run_leg(min(sweep), leg_s)
+
+    gates = {
+        "max_sustained_qps_positive": max_sustained > 0,
+        "fleet_histogram_nonempty": sweep_hist_nonempty,
+        "overload_actually_shed": over["shed_refused"]
+        + over["shed_stale"] > 0,
+        "overload_interactive_p99_bounded":
+            0 < over["tier_p99_ms"]["interactive"] < over_p99_ms,
+        "overload_crawlbot_shed": crawl_shed > 0,
+        "all_sheds_counted": (
+            over["responses"] == over["arrivals"]
+            and over["codes"].get("503", 0) == over["shed_refused"]
+            and over["codes"].get("-1", 0) == 0),
+        "queue_drained_post_burst": drained,
+        "shed_before_membudget_refusal":
+            over["membudget_reject_serve"] == 0,
+        "recovery_p99_ok": (0 < recovery["p99_ms"] < p99_ms
+                            and recovery["responses"]
+                            == recovery["arrivals"]),
+    }
+    ok = all(gates.values())
+    rep = {
+        "metric": "load_gate", "value": round(max_sustained, 1),
+        "unit": "qps_at_p99_lt_%dms" % int(p99_ms),
+        "ok": ok, "gates": gates,
+        "max_sustained_qps": round(max_sustained, 1),
+        "capacity_est_qps": round(capacity, 1),
+        "sweep": legs, "overload": over, "recovery": recovery,
+    }
+    print(json.dumps(rep))
+    pool.shutdown(wait=False)
+    g_chaos.disable()
+    srv.stop()
+    client.close()
+    for n in nodes:
+        n.stop()
+    return rep
+
+
 if __name__ == "__main__":
     if os.environ.get("BENCH_SOAK"):
         sys.exit(0 if main_soak()["ok"] else 1)
@@ -1285,5 +1519,7 @@ if __name__ == "__main__":
         main_jit()
     elif os.environ.get("BENCH_SLO"):
         sys.exit(0 if main_slo()["ok"] else 1)
+    elif os.environ.get("BENCH_LOAD"):
+        sys.exit(0 if main_load()["ok"] else 1)
     else:
         main()

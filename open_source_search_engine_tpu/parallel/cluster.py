@@ -52,6 +52,7 @@ from ..index.collection import Collection
 from ..utils import chaos as chaos_mod
 from ..utils import deadline as deadline_mod
 from ..utils import ghash
+from ..utils import priority as priority_mod
 from ..utils import threads
 from ..utils import trace as trace_mod
 from ..utils.lockcheck import make_lock, make_rlock
@@ -546,6 +547,15 @@ class ShardNodeServer:
                     nice = int(self.headers.get("X-Niceness") or 0)
                 except ValueError:
                     nice = 0
+                # honor the coordinator's priority verdict: a crawlbot
+                # leg yields inside this host too (its tier maps to the
+                # niceness bit the gate below already enforces), and
+                # the tier is re-bound so further fan-out keeps it
+                tier = priority_mod.tier_from_header(
+                    self.headers.get(priority_mod.PRIORITY_HEADER))
+                if tier is not None:
+                    g_stats.count(f"admission.node.{tier}")
+                    nice = max(nice, priority_mod.tier_niceness(tier))
                 accept_bin = BIN_CONTENT_TYPE in (
                     self.headers.get("Accept") or "")
                 # adopt an incoming trace context: run the handler
@@ -571,7 +581,8 @@ class ShardNodeServer:
                     else:
                         payload = transport_mod.decode_body(
                             body, self.headers.get("Content-Type", ""))
-                        with deadline_mod.bind(dl):
+                        with deadline_mod.bind(dl), \
+                                priority_mod.bind_tier(tier):
                             if tr_hdr is not None:
                                 with trace_mod.g_tracer.adopt(
                                         tr_hdr[0], tr_hdr[1],
@@ -739,11 +750,11 @@ class _ShardSearchBatcher:
 
     def submit(self, q: str, topk: int, lang: int,
                timeout: float, parent_span=None,
-               deadline=None) -> dict | None:
+               deadline=None, tier=None) -> dict | None:
         holder = {"done": False, "out": None}
         with self._cv:
             self._queue.append(((topk, lang), q, holder, parent_span,
-                                deadline))
+                                deadline, tier))
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threads.spawn(
                     f"shard{self.shard}-qbatch", self._run)
@@ -783,20 +794,26 @@ class _ShardSearchBatcher:
 
     def _issue(self, key: tuple, batch: list) -> None:
         topk, lang = key
-        qs = [q for _, q, _, _, _ in batch]
+        qs = [e[1] for e in batch]
         # the batcher runs in its own thread (empty contextvars
         # context); re-attach the first waiter's span so the coalesced
         # RPC lands in SOME trace, and give every other waiter a
         # completed "coalesced" marker span covering the same interval
-        parents = [p for _, _, _, p, _ in batch if p is not None]
+        parents = [e[3] for e in batch if e[3] is not None]
         primary = parents[0] if parents else None
         # the coalesced RPC carries the LONGEST rider budget — a
         # short-deadline rider must not abandon every other rider's
-        # answer (its own coordinator still times out client-side)
-        dls = [d for _, _, _, _, d in batch if d is not None]
+        # answer (its own coordinator still times out client-side) —
+        # and the HIGHEST rider tier (a crawlbot rider must not demote
+        # an interactive rider's leg on the node planes)
+        dls = [e[4] for e in batch if e[4] is not None]
         dl = max(dls, key=lambda d: d.at) if dls else None
+        tiers = [e[5] for e in batch if e[5] is not None]
+        tier = (min(tiers, key=priority_mod.TIERS.index)
+                if tiers else None)
         t0 = time.perf_counter()
-        with trace_mod.attach(primary), deadline_mod.bind(dl):
+        with trace_mod.attach(primary), deadline_mod.bind(dl), \
+                priority_mod.bind_tier(tier):
             # span_parent rides along so the hedged read's per-attempt
             # spans (hedge fired/won) land in the primary rider's trace
             out = self.client._read_shard(
@@ -816,9 +833,9 @@ class _ShardSearchBatcher:
             p.record("rpc/search", t0, coalesced=True,
                      shard=self.shard, batch=len(qs))
         with self._cv:
-            for (_, _, holder, _, _), res in zip(batch, results):
-                holder["out"] = res
-                holder["done"] = True
+            for e, res in zip(batch, results):
+                e[2]["out"] = res
+                e[2]["done"] = True
             self._cv.notify_all()
 
 
@@ -1211,7 +1228,7 @@ class ClusterClient:
 
     def _search_shard(self, shard: int, q: str, topk: int,
                       lang: int, parent_span=None,
-                      deadline=None) -> dict | None:
+                      deadline=None, tier=None) -> dict | None:
         """One shard's leg of the scatter: rides the per-shard batcher
         so concurrent queries coalesce into one (hedged) RPC.
         ``parent_span`` carries the caller's trace across the
@@ -1231,7 +1248,8 @@ class ClusterClient:
         out = self._batchers[shard].submit(q, topk, lang,
                                            SEARCH_TIMEOUT_S,
                                            parent_span=parent_span,
-                                           deadline=deadline)
+                                           deadline=deadline,
+                                           tier=tier)
         if out is not None and out.get("ok", True):
             self._leg_cache.put(key, out, gen=gen)
         return out
@@ -1313,14 +1331,16 @@ class ClusterClient:
 
         want = max(topk + offset, PQR_SCAN)
         over = max(want * 2, 16)
-        # the scatter span (and the query deadline) are handed to each
-        # leg explicitly: the legs run on read-pool threads, where
-        # contextvars do not follow
+        # the scatter span (and the query deadline + tier) are handed
+        # to each leg explicitly: the legs run on read-pool threads,
+        # where contextvars do not follow
         scatter_sp = trace_mod.begin("scatter",
                                      shards=self.conf.n_shards)
         dl = deadline_mod.current()
+        tier = priority_mod.current_tier()
         futs = [self._read_pool.submit(
-            self._search_shard, s, q, over, lang, scatter_sp, dl)
+            self._search_shard, s, q, over, lang, scatter_sp, dl,
+            tier)
             for s in range(self.conf.n_shards)]
         total = 0
         docids: list[int] = []

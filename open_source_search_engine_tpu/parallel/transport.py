@@ -43,7 +43,7 @@ from typing import Callable
 import numpy as np
 
 from ..utils import chaos as chaos_mod, deadline as deadline_mod, \
-    threads, trace as trace_mod
+    priority as priority_mod, threads, trace as trace_mod
 from ..utils.lockcheck import make_lock
 from ..utils.log import get_logger
 from ..utils.stats import g_stats
@@ -416,6 +416,12 @@ class Transport:
             # budget, not an absolute clock — wall clocks don't agree
             # across hosts (the node rebuilds a local Deadline from it)
             headers[deadline_mod.DEADLINE_HEADER] = dl.header_value()
+        tier = priority_mod.current_tier()
+        if tier is not None:
+            # the front door's priority verdict rides every scatter
+            # leg, so node planes honor the tier too (crawlbot work
+            # yields inside each host, not just at the coordinator)
+            headers[priority_mod.PRIORITY_HEADER] = tier
         t0 = time.monotonic()
         for attempt in (0, 1):
             conn, reused = self._checkout(addr, timeout)
@@ -526,6 +532,7 @@ class Transport:
         parent = span_parent if span_parent is not None else \
             trace_mod.current_span()
         dl = deadline_mod.current()
+        tier = priority_mod.current_tier()
         deadline = deadline_mod.Deadline.after(timeout)
         if dl is not None and dl.at < deadline.at:
             deadline = dl  # the query budget runs out first
@@ -542,7 +549,9 @@ class Transport:
                 # span= only when tracing: tests monkeypatch request()
                 # with the plain 5-arg signature
                 kw = {} if spans[i] is None else {"span": spans[i]}
-                with deadline_mod.bind(dl):
+                # launch threads start with empty contextvars: re-bind
+                # the caller's deadline AND tier so both ride the wire
+                with deadline_mod.bind(dl), priority_mod.bind_tier(tier):
                     out = self.request(addrs[i], path, payload,
                                        timeout=timeout,
                                        niceness=niceness, **kw)

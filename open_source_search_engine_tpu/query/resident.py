@@ -31,7 +31,6 @@ wave is still reading.
 from __future__ import annotations
 
 import threading
-import time
 from collections import deque
 from typing import Callable
 
@@ -39,6 +38,8 @@ from ..utils import deadline as deadline_mod
 from ..utils import threads as _threads
 from ..utils.chaos import g_chaos
 from ..utils.log import get_logger
+from ..utils.membudget import g_membudget
+from ..utils.priority import QueueFull
 from ..utils.stats import g_stats
 
 log = get_logger("resident")
@@ -48,10 +49,14 @@ log = get_logger("resident")
 #: HBM for every staged wave
 DEPTH = 2
 
-#: brief collect window when the device is idle, letting concurrent
-#: submitters land in one wave (the QueryBatcher upstream coalesces
-#: HTTP waiters the same way)
-WINDOW_S = 0.0005
+#: bounded submit queue (admission plane): an overload burst fails
+#: fast with QueueFull — counted, charged to the membudget "serve"
+#: label — instead of growing host memory without bound
+MAX_QUEUE = 1024
+
+#: per-ticket footprint estimate for the membudget gauge (plans list +
+#: ticket slots + event)
+QUEUE_ENTRY_COST = 2048
 
 
 class Ticket:
@@ -115,10 +120,12 @@ class ResidentLoop:
 
     def __init__(self, di_fn: Callable[[], object],
                  gen_fn: Callable[[], int],
-                 max_batch: int = 64, name: str = "coll"):
+                 max_batch: int = 64, name: str = "coll",
+                 max_queue: int = MAX_QUEUE):
         self._di_fn = di_fn
         self._gen_fn = gen_fn
         self._max_batch = max_batch
+        self._max_queue = max_queue
         self._cv = threading.Condition()
         self._queue: deque[Ticket] = deque()
         self._inflight: deque[_Wave] = deque()
@@ -143,9 +150,20 @@ class ResidentLoop:
             if not self._alive:
                 t._fail(RuntimeError("resident loop stopped"))
                 return t
+            if len(self._queue) >= self._max_queue:
+                # bounded admission: fail the ticket, never the loop —
+                # the serve edge turns QueueFull into shed-stale-or-503
+                g_stats.count("admission.queue_full")
+                t._fail(QueueFull("resident loop queue full"))
+                return t
             self._queue.append(t)
+            self._gauge_locked()
             self._cv.notify_all()
         return t
+
+    def _gauge_locked(self) -> None:
+        g_membudget.set_gauge(
+            "serve", self, len(self._queue) * QUEUE_ENTRY_COST)
 
     def stop(self) -> None:
         """Kill the loop; queued and in-flight waiters fail fast."""
@@ -167,9 +185,12 @@ class ResidentLoop:
                             RuntimeError("resident loop stopped"))
                         return
                 if not self._inflight and self._queue:
-                    # idle device: give concurrent submitters one brief
-                    # window to share the wave
-                    time.sleep(WINDOW_S)
+                    # fill-or-flush: the device is IDLE — launch now
+                    # with whatever is queued (a collect window in
+                    # front of idle hardware is pure added latency);
+                    # while waves are in flight, submitters coalesce
+                    # naturally until the pipeline frees a slot
+                    g_stats.count("resident.idle_flush")
                 if len(self._inflight) < DEPTH:
                     self._issue_one()
                 if self._inflight and (
@@ -186,6 +207,7 @@ class ResidentLoop:
         for t in self._queue:
             t._fail(exc)
         self._queue.clear()
+        self._gauge_locked()
         for w in self._inflight:
             for t in w.tickets:
                 t._fail(exc)
@@ -207,6 +229,7 @@ class ResidentLoop:
                     break
                 batch.append(self._queue.popleft())
                 nplans += len(t.plans)
+            self._gauge_locked()
             return batch
 
     def _index_for_issue(self):

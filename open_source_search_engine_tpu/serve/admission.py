@@ -1,0 +1,262 @@
+"""Admission plane — bounded, tiered, shed-before-refuse overload gate.
+
+Reference: the engine survived open-internet traffic because Msg40 /
+HttpServer degraded instead of collapsing — AutoBan rejected abusive
+sources at the door, queries queued into Msg39 waves instead of
+spawning unbounded work, cached answers went out when fresh ones were
+over budget (``maxQueryTime``), and spider traffic yielded the niceness
+bit. This module is that discipline for the device-serving planes: a
+bounded gate in front of ``QueryBatcher``/``ResidentLoop`` that admits
+by priority tier and sheds *cheaply* — a same-generation SWR-stale
+answer marked degraded, else 503 + Retry-After — long before the
+membudget has to refuse real work.
+
+Shed triggers, in the order they are consulted:
+
+1. the bounded queue is full (``admission.queue_full``) — an overload
+   burst must never grow host memory without bound;
+2. the SLO tracker reports a burning error budget (``slo.degraded``)
+   or the membudget is out of headroom — background tiers shed at the
+   door while the signal stands;
+3. the *predicted* queue delay (waiters ahead x EWMA service time)
+   would eat the request's deadline — shedding now is strictly cheaper
+   than timing out later (the metastable-collapse preventer: work that
+   cannot finish in time never enters the queue).
+
+The tier vocabulary (names, header, contextvar) lives in
+``utils/priority.py`` so ``parallel/`` can stamp scatter legs without
+importing the serve layer.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from collections import deque
+
+from ..utils import deadline as deadline_mod
+from ..utils import trace as trace_mod
+from ..utils.membudget import g_membudget
+from ..utils.priority import TIERS
+from ..utils.slo import g_slo
+from ..utils.stats import g_stats
+
+
+class Shed(RuntimeError):
+    """The gate refused this request. ``reason`` names the trigger
+    (``queue_full``/``signal``/``deadline``/``timeout``);
+    ``retry_after_s`` is the Retry-After hint for the 503 path."""
+
+    def __init__(self, reason: str, retry_after_s: float = 1.0):
+        super().__init__(f"admission shed: {reason}")
+        self.reason = reason
+        self.retry_after_s = max(float(retry_after_s), 1.0)
+
+
+class _Admitted:
+    """The held slot; a context manager so the release (and the
+    service-time EWMA feeding the delay predictor) can't be skipped."""
+
+    __slots__ = ("_gate", "_t0")
+
+    def __init__(self, gate: "AdmissionGate"):
+        self._gate = gate
+        self._t0 = time.monotonic()
+
+    def __enter__(self) -> "_Admitted":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        # monotonic delta = budget arithmetic for the predictor, not a
+        # reported latency (those ride trace.record below)
+        self._gate._release(time.monotonic() - self._t0)
+
+
+class AdmissionGate:
+    """Bounded admission in front of the device dispatch planes.
+
+    ``max_inflight`` bounds concurrently *running* requests (past the
+    gate, the QueryBatcher/ResidentLoop coalesce them into waves);
+    ``max_queue`` bounds waiters across all tiers. Waiters wake in
+    strict tier order — interactive first, FIFO within a tier — so a
+    crawlbot burst can delay at most the wave in flight, never the
+    queue ahead of a human."""
+
+    def __init__(self, max_inflight: int = 32, max_queue: int = 256,
+                 max_wait_s: float = 2.0,
+                 degraded_fn=None, pressure_fn=None):
+        self.max_inflight = int(max_inflight)
+        self.max_queue = int(max_queue)
+        self.max_wait_s = float(max_wait_s)
+        #: overridable overload signals (tests aim them; defaults are
+        #: the live SLO burn-rate and membudget headroom planes)
+        self._degraded_fn = degraded_fn or (lambda: g_slo.degraded())
+        self._pressure_fn = pressure_fn or self._mem_pressure
+        self._cv = threading.Condition()
+        self._inflight = 0
+        self._waiting: dict[str, deque] = {t: deque() for t in TIERS}
+        #: EWMA of admitted service time (s) — the queue-delay
+        #: predictor's clock; seeded pessimistically so a cold gate
+        #: sheds late rather than early
+        self._svc_s = 0.020
+        self.admitted_total = 0
+        self.shed_total = 0
+
+    @staticmethod
+    def _mem_pressure() -> bool:
+        # under ~6% headroom the next reserve is likely to refuse real
+        # work — shed background traffic first (the OOM-merge-defer
+        # ancestor: degrade cheap things before expensive things fail)
+        return g_membudget.free() < (g_membudget.limit >> 4)
+
+    # --- admission --------------------------------------------------------
+
+    def admit(self, tier: str, deadline=None) -> _Admitted:
+        """Admit or raise :class:`Shed`. Blocks (bounded by the
+        request deadline and ``max_wait_s``) while the gate is full."""
+        if tier not in TIERS:
+            tier = "interactive"
+        t_enq = time.perf_counter()
+        with self._cv:
+            n_wait = sum(len(q) for q in self._waiting.values())
+            if n_wait >= self.max_queue:
+                g_stats.count("admission.queue_full")
+                raise self._shed_locked(tier, "queue_full")
+            if tier != "interactive" and \
+                    (self._degraded_fn() or self._pressure_fn()):
+                # the cheap early shed: while the error budget burns or
+                # memory headroom is gone, background tiers never enter
+                raise self._shed_locked(tier, "signal")
+            est = self._est_wait_locked(tier)
+            if deadline is not None and (
+                    deadline.expired() or est > deadline.remaining()):
+                raise self._shed_locked(tier, "deadline")
+            if self._inflight < self.max_inflight and \
+                    not self._ahead_locked(tier):
+                self._inflight += 1
+                self.admitted_total += 1
+            else:
+                self._wait_locked(tier, deadline)
+        g_stats.count("admission.admitted")
+        trace_mod.record("admission.queue_delay", t_enq)
+        return _Admitted(self)
+
+    def _ahead_locked(self, tier: str) -> bool:
+        """Any waiter at the same or higher priority? (FIFO within a
+        tier; a new arrival never jumps its own class.)"""
+        for t in TIERS:
+            if self._waiting[t]:
+                return True
+            if t == tier:
+                return False
+        return False
+
+    def _est_wait_locked(self, tier: str) -> float:
+        """Predicted queue delay: slots drain at ``max_inflight`` per
+        EWMA service time; this tier waits behind every same-or-higher
+        waiter plus the waves in flight."""
+        ahead = 0
+        for t in TIERS:
+            ahead += len(self._waiting[t])
+            if t == tier:
+                break
+        backlog = ahead + self._inflight
+        if backlog < self.max_inflight:
+            return 0.0
+        return (backlog / max(self.max_inflight, 1)) * self._svc_s
+
+    def _wait_locked(self, tier: str, deadline) -> None:
+        w = {"go": False}
+        self._waiting[tier].append(w)
+        g_stats.count("admission.queued")
+        budget = deadline_mod.Deadline.after(self.max_wait_s)
+        if deadline is not None and deadline.at < budget.at:
+            budget = deadline
+        while not w["go"]:
+            left = budget.remaining()
+            if left <= 0:
+                break
+            self._cv.wait(left)
+        if not w["go"]:
+            # grant pops under this lock, so un-granted => still queued
+            self._waiting[tier].remove(w)
+            raise self._shed_locked(
+                tier, "deadline" if deadline is not None
+                and deadline.expired() else "timeout")
+        self.admitted_total += 1  # _grant_locked took the slot for us
+
+    def _shed_locked(self, tier: str, reason: str) -> Shed:
+        self.shed_total += 1
+        g_stats.count(f"admission.shed.reason.{reason}")
+        retry = max(self._est_wait_locked(tier), self._svc_s)
+        return Shed(reason, retry_after_s=retry)
+
+    def _release(self, service_s: float) -> None:
+        with self._cv:
+            self._svc_s += 0.2 * (max(service_s, 0.0) - self._svc_s)
+            self._inflight -= 1
+            self._grant_locked()
+            self._cv.notify_all()
+
+    def _grant_locked(self) -> None:
+        while self._inflight < self.max_inflight:
+            w = None
+            for t in TIERS:
+                if self._waiting[t]:
+                    w = self._waiting[t].popleft()
+                    break
+            if w is None:
+                return
+            w["go"] = True
+            self._inflight += 1
+
+    # --- observability ----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._cv:
+            return {
+                "inflight": self._inflight,
+                "max_inflight": self.max_inflight,
+                "max_queue": self.max_queue,
+                "queued": {t: len(self._waiting[t]) for t in TIERS},
+                "queued_total": sum(len(q)
+                                    for q in self._waiting.values()),
+                "svc_ewma_ms": round(self._svc_s * 1000.0, 3),
+                "admitted_total": self.admitted_total,
+                "shed_total": self.shed_total,
+            }
+
+    def idle(self) -> bool:
+        """Nothing admitted and nothing waiting — the post-burst
+        drained state the load harness polls for."""
+        with self._cv:
+            return self._inflight == 0 and not any(
+                self._waiting[t] for t in TIERS)
+
+
+# --- response-header side channel -----------------------------------------
+# handle() returns (status, payload, ctype); the shed path needs to add
+# Retry-After without widening that contract for every route. The gate
+# stashes extra headers in a contextvar the HTTP handler drains on the
+# same thread (direct handle() callers also get the value in the JSON
+# body, so tests and bench never need the header channel).
+
+_resp_headers: contextvars.ContextVar = contextvars.ContextVar(
+    "osse-admission-resp-headers", default=None)
+
+
+def set_response_header(name: str, value: str) -> None:
+    cur = _resp_headers.get()
+    if cur is None:
+        cur = []
+        _resp_headers.set(cur)
+    cur.append((name, value))
+
+
+def pop_response_headers() -> list:
+    cur = _resp_headers.get()
+    if cur:
+        _resp_headers.set(None)
+        return list(cur)
+    return []

@@ -40,10 +40,12 @@ from ..utils.lockcheck import make_lock, make_rlock
 from ..utils.log import get_logger
 from ..utils.membudget import g_membudget
 from ..utils import parms as parms_mod
+from ..utils import priority as priority_mod
 from ..utils import trace as trace_mod
 from ..utils.parms import Conf
 from ..utils.stats import g_stats
 from ..utils.trace import g_tracer
+from . import admission as admission_mod
 
 log = get_logger("http")
 
@@ -60,6 +62,13 @@ class QueryBatcher:
 
     MAX_B = 64
     WINDOW_S = 0.002  # brief collect window once a first query arrives
+    #: bounded admission: an overload burst fails fast with QueueFull
+    #: (the serve edge sheds stale-or-503) instead of growing host
+    #: memory without bound
+    MAX_QUEUE = 512
+    #: per-waiter footprint estimate charged to the membudget "serve"
+    #: label (query string + holder + span/deadline refs)
+    QUEUE_ENTRY_COST = 4096
 
     def __init__(self, run_batch):
         #: run_batch((coll_name, topk, offset), [queries]) → [results]
@@ -67,6 +76,7 @@ class QueryBatcher:
         self._cv = threading.Condition()
         #: (key, query, holder, parent span | None)
         self._queue: list[tuple] = []
+        self._inflight = 0  # device waves currently dispatched
         self._alive = True
         # two executors so batch N's host post-processing (titledb
         # reads, clustering) overlaps batch N+1's device waves
@@ -87,8 +97,13 @@ class QueryBatcher:
             for e in self._queue:
                 e[2]["err"] = RuntimeError("query batcher stopped")
             self._queue.clear()
+            self._gauge_locked()
             self._cv.notify_all()
         self._pool.shutdown(wait=False)
+
+    def _gauge_locked(self) -> None:
+        g_membudget.set_gauge(
+            "serve", self, len(self._queue) * self.QUEUE_ENTRY_COST)
 
     def search(self, key: tuple, q: str, timeout: float = 60.0):
         holder: dict = {}
@@ -99,8 +114,14 @@ class QueryBatcher:
         if dl is not None and dl.at < deadline.at:
             deadline = dl
         with self._cv:
+            if len(self._queue) >= self.MAX_QUEUE:
+                g_stats.count("admission.queue_full")
+                raise priority_mod.QueueFull(
+                    "query batcher queue full")
             self._queue.append((key, q, holder,
-                                trace_mod.current_span(), dl))
+                                trace_mod.current_span(), dl,
+                                priority_mod.current_tier()))
+            self._gauge_locked()
             self._cv.notify_all()
             while "res" not in holder and "err" not in holder:
                 left = deadline.remaining()
@@ -121,24 +142,50 @@ class QueryBatcher:
                     self._cv.wait()
                 if not self._alive:
                     return
-            time.sleep(self.WINDOW_S)  # let concurrent arrivals land
-            with self._cv:
+                # fill-or-flush: a wave already in flight buys a
+                # collect window (up to WINDOW_S hoping to fill a
+                # same-key batch); an IDLE device launches immediately
+                # with whatever is queued — queueing in front of idle
+                # hardware is pure added latency
+                if self._inflight > 0:
+                    w = deadline_mod.Deadline.after(self.WINDOW_S)
+                    while (self._alive and self._inflight > 0
+                           and len(self._queue) < self.MAX_B):
+                        left = w.remaining()
+                        if left <= 0:
+                            break
+                        self._cv.wait(timeout=left)
+                else:
+                    g_stats.count("admission.wave.idle_flush")
+                if not self._alive:
+                    return
                 if not self._queue:  # stop() drained it mid-window
                     continue
                 key = self._queue[0][0]
                 batch = [e for e in self._queue if e[0] == key][: self.MAX_B]
                 for e in batch:
                     self._queue.remove(e)
+                self._gauge_locked()
+                self._inflight += 1
             try:
                 self._pool.submit(self._run_one, key, batch)
             except RuntimeError as exc:  # pool shut down by stop()
                 with self._cv:
+                    self._inflight -= 1
                     for e in batch:
                         e[2]["err"] = exc
                     self._cv.notify_all()
                 return
 
     def _run_one(self, key, batch) -> None:
+        try:
+            self._run_one_inner(key, batch)
+        finally:
+            with self._cv:
+                self._inflight -= 1
+                self._cv.notify_all()  # wake the fill-or-flush window
+
+    def _run_one_inner(self, key, batch) -> None:
         try:
             # worker thread = empty contextvars context; re-attach the
             # first traced waiter's span so the coalesced dispatch
@@ -152,9 +199,15 @@ class QueryBatcher:
             dls = [e[4] for e in batch
                    if len(e) > 4 and e[4] is not None]
             dl = max(dls, key=lambda d: d.at) if dls else None
+            # ...and under the HIGHEST rider tier: a crawlbot rider
+            # must not demote an interactive rider's coalesced wave
+            tiers = [e[5] for e in batch
+                     if len(e) > 5 and e[5] is not None]
+            tier = (min(tiers, key=priority_mod.TIERS.index)
+                    if tiers else None)
             t0 = time.perf_counter()
             with trace_mod.attach(parents[0] if parents else None), \
-                    deadline_mod.bind(dl):
+                    deadline_mod.bind(dl), priority_mod.bind_tier(tier):
                 res = self._run_batch(key, [e[1] for e in batch])
             for p in parents[1:]:
                 p.record("query.device_batch", t0, coalesced=True,
@@ -279,6 +332,10 @@ class SearchHTTPServer:
         #: /search micro-batching (flat device path only; the sharded
         #: and cluster planes batch at their own layers)
         self._batcher = QueryBatcher(self._run_device_batch)
+        #: admission plane: bounded, tiered gate in front of the
+        #: dispatch planes — sheds stale-or-503 before the membudget
+        #: ever has to refuse real work (serve/admission.py)
+        self.admission = admission_mod.AdmissionGate()
         #: statsdb persistence (reference Statsdb: an on-disk ring of
         #: timestamped metric samples behind PagePerf graphs)
         self._statsdb_path = Path(base_dir) / "statsdb.jsonl"
@@ -343,6 +400,11 @@ class SearchHTTPServer:
             recent = [t for t in hits if t > now - 1.0]
             if len(recent) > limit_qps:
                 self._ab_banned[ip] = now + self.BAN_COOLDOWN_S
+                # the cooldown IS the penalty: drop the window so the
+                # first post-ban request is judged on fresh traffic —
+                # stale pre-ban hits must not re-ban it on sight (a
+                # banned client could otherwise never requalify)
+                self._ab_hits.pop(ip, None)
                 if len(self._ab_banned) > 4096:
                     self._ab_banned = {
                         k: v for k, v in self._ab_banned.items()
@@ -391,20 +453,28 @@ class SearchHTTPServer:
 
     def handle(self, method: str, path: str, query: dict,
                body: bytes, client_ip: str = "",
-               niceness: int = 0) -> tuple[int, str, str]:
+               niceness: int = 0,
+               tier: str | None = None) -> tuple[int, str, str]:
         """Route one request → (status, payload, content_type).
         The Pages.cpp s_pages[] table, as a method. Background
         (niceness-1) requests yield to in-flight interactive ones
-        (UdpProtocol.h niceness bit)."""
+        (UdpProtocol.h niceness bit). ``tier`` is a propagated
+        X-OSSE-Priority verdict, if the caller carried one."""
+        # drop any extra response headers a previous request left on
+        # this thread's context (direct handle() callers never pop)
+        admission_mod.pop_response_headers()
         self.nice_gate.enter(niceness)
         try:
             return self._handle_inner(method, path, query, body,
-                                      client_ip)
+                                      client_ip, niceness=niceness,
+                                      header_tier=tier)
         finally:
             self.nice_gate.exit(niceness)
 
     def _handle_inner(self, method: str, path: str, query: dict,
-                      body: bytes, client_ip: str = ""
+                      body: bytes, client_ip: str = "",
+                      niceness: int = 0,
+                      header_tier: str | None = None
                       ) -> tuple[int, str, str]:
         try:
             if path == "/":
@@ -420,6 +490,7 @@ class SearchHTTPServer:
                 limit = int(coll.conf.autoban_qps) if coll is not None \
                     else int(parms_mod.parm("autoban_qps").default)
                 if self._autobanned(client_ip, limit):
+                    g_stats.count("autoban.rejected")
                     return 429, json.dumps(
                         {"error": "query rate limit (autoban)"}), \
                         "application/json"
@@ -428,10 +499,17 @@ class SearchHTTPServer:
                     return 404, json.dumps(
                         {"error": "no such collection"}), \
                         "application/json"
+                # front-door classification (admission plane): explicit
+                # tier= param > propagated header > niceness bit, else
+                # interactive — bound so scatter legs inherit it
+                tier = priority_mod.classify(query, niceness=niceness,
+                                             header_tier=header_tier)
+                g_stats.count(f"admission.tier.{tier}")
                 # NOT under the global lock: the micro-batcher would
                 # deadlock (its worker takes the lock), and holding it
                 # per-request caps the plane at 1/latency qps
-                return self._page_search(query)
+                with priority_mod.bind_tier(tier):
+                    return self._page_search(query, tier=tier)
             with self._lock:
                 return self._route(method, path, query, body)
         except Exception as e:  # noqa: BLE001 — server must not die
@@ -513,6 +591,8 @@ class SearchHTTPServer:
             return self._page_parms(query)
         if path == "/admin/jit":
             return self._page_jit(query)
+        if path == "/admin/admission":
+            return self._page_admission(query)
         return 404, json.dumps({"error": "no such page"}), \
             "application/json"
 
@@ -536,7 +616,8 @@ class SearchHTTPServer:
                 '<input name="q"><input type="submit" value="search">'
                 "</form></body></html>")
 
-    def _page_search(self, query: dict) -> tuple[int, str, str]:
+    def _page_search(self, query: dict,
+                     tier: str = "interactive") -> tuple[int, str, str]:
         q = query.get("q", "")
         if not q:
             return 400, json.dumps({"error": "missing q"}), \
@@ -545,11 +626,15 @@ class SearchHTTPServer:
         # id in the body so the waterfall can be pulled up by id
         debug = query.get("debug", "") not in ("", "0")
         with g_tracer.start("search", sampled=True if debug else None,
-                            q=q) as tr:
+                            q=q, tier=tier) as tr:
             # the whole-request latency histogram (cache hits and
-            # degraded answers included) — what a single-node SLO reads
-            with trace_mod.timed_span("serve.search"):
-                out = self._page_search_traced(query, q, debug, tr)
+            # degraded answers included) — what a single-node SLO
+            # reads; the per-tier twin is what the overload harness
+            # asserts on (interactive p99 bounded while crawlbot sheds)
+            with trace_mod.timed_span("serve.search"), \
+                    trace_mod.timed_span(f"serve.search.{tier}"):
+                out = self._page_search_traced(query, q, debug, tr,
+                                               tier=tier)
         return out
 
     def _query_deadline(self, query: dict):
@@ -566,7 +651,8 @@ class SearchHTTPServer:
         return deadline_mod.Deadline.after(ms / 1000.0)
 
     def _page_search_traced(self, query: dict, q: str, debug: bool,
-                            tr) -> tuple[int, str, str]:
+                            tr, tier: str = "interactive"
+                            ) -> tuple[int, str, str]:
         n = min(int(query.get("n", 10)), 100)
         # deep paging: first result number (reference PageResults s=),
         # bounded so a hostile s can't force a corpus-sized top-k
@@ -587,13 +673,33 @@ class SearchHTTPServer:
             gen = self._result_gen(rc_coll)
             ckey = (cname, q, n, s, fmt)
         dl = self._query_deadline(query)
+        # a fresh cache hit bypasses the admission gate entirely —
+        # serving from memory costs nothing the gate protects, and
+        # under overload the hot head of the Zipf mix must keep
+        # answering (the reference's Msg17 hits skip Msg39 queueing)
+        if ckey is not None:
+            hit, page = self._result_cache.lookup(ckey, gen=gen)
+            if hit:
+                self.stats["result_cache_hits"] = \
+                    self.stats.get("result_cache_hits", 0) + 1
+                trace_mod.tag(result_cache="hit")
+                return page
         try:
-            with deadline_mod.bind(dl):
+            token = self.admission.admit(tier, deadline=dl)
+        except admission_mod.Shed as shed:
+            return self._shed_response(shed, ckey, gen)
+        try:
+            with token, deadline_mod.bind(dl):
                 out = self._search_cached(query, q, n, s, fmt, rc_coll,
                                           debug, tr, ckey, gen, ttl,
                                           swr)
             deadline_mod.note_met(dl)
             return out
+        except priority_mod.QueueFull:
+            # a bounded dispatch queue (batcher/resident) refused the
+            # enqueue past the gate — same shed ladder, same accounting
+            return self._shed_response(
+                admission_mod.Shed("queue_full"), ckey, gen)
         except deadline_mod.DeadlineExceeded:
             # budget burned downstream: the cache plane's just-expired
             # answer (same generation — a write still invalidates)
@@ -611,6 +717,31 @@ class SearchHTTPServer:
             g_stats.count("deadline.refused")
             return 504, json.dumps({"error": "deadline exceeded"}), \
                 "application/json"
+
+    def _shed_response(self, shed: admission_mod.Shed, ckey, gen
+                       ) -> tuple[int, str, str]:
+        """The shed ladder, cheapest first: the cache plane's
+        same-generation SWR-stale answer marked degraded, else 503 +
+        Retry-After. Every shed is counted — the load harness asserts
+        none are silently lost."""
+        if ckey is not None:
+            hit, page = self._result_cache.lookup_stale(ckey, gen=gen)
+            if hit:
+                g_stats.count("admission.shed.stale")
+                trace_mod.tag(admission=shed.reason,
+                              results="degraded")
+                self.stats["admission_stale"] = \
+                    self.stats.get("admission_stale", 0) + 1
+                return page
+        g_stats.count("admission.shed.refused")
+        trace_mod.tag(admission=shed.reason, results="refused")
+        self.stats["admission_refused"] = \
+            self.stats.get("admission_refused", 0) + 1
+        retry = max(1, int(round(shed.retry_after_s)))
+        admission_mod.set_response_header("Retry-After", str(retry))
+        return 503, json.dumps(
+            {"error": f"overloaded ({shed.reason})",
+             "retryAfter": retry}), "application/json"
 
     def _search_cached(self, query: dict, q: str, n: int, s: int,
                        fmt: str, rc_coll, debug: bool, tr, ckey, gen,
@@ -693,6 +824,10 @@ class SearchHTTPServer:
                     (query.get("c", "main"), n, s), q)
             except deadline_mod.DeadlineExceeded:
                 raise  # serve edge owns expiry (stale-or-504)
+            except priority_mod.QueueFull:
+                # overload: the host-fallback path below would ADD load
+                # exactly when the plane is saturated — shed instead
+                raise
             except Exception as e:  # noqa: BLE001 — degrade, don't 500
                 log.warning("device search failed (%s); host fallback",
                             e)
@@ -876,7 +1011,8 @@ class SearchHTTPServer:
         links = "".join(
             f'<li><a href="/admin/{p}{sfx}">{p}</a></li>'
             for p in ("stats", "hosts", "perf", "mem", "transport",
-                      "cache", "traces", "parms", "jit", "profiler",
+                      "cache", "traces", "parms", "jit", "admission",
+                      "profiler",
                       "graph")) + '<li><a href="/metrics">metrics</a></li>'
         rows = "".join(f"<tr><td>{k}</td><td>{v}</td></tr>"
                        for k, v in self.stats.items())
@@ -885,6 +1021,38 @@ class SearchHTTPServer:
                 f"<h1>admin</h1><p>collections: {colls}</p>"
                 f"<ul>{links}</ul><table border=1>{rows}</table>"
                 f"</body></html>")
+
+    def _page_admission(self, query: dict) -> tuple[int, str, str]:
+        """Admission-plane view: gate occupancy + tier queues, the
+        shed/tier counters, and the queue-delay histogram.
+        ``?format=json`` returns the raw snapshot."""
+        snap = self.admission.snapshot()
+        adm = g_stats.prefixed("admission.")
+        snap["counters"] = dict(sorted(adm["counters"].items()))
+        snap["queue_delay"] = adm["latencies"].get(
+            "admission.queue_delay", {})
+        if query.get("format") == "json":
+            return 200, json.dumps(snap), "application/json"
+        qrows = "".join(f"<tr><td>{t}</td><td>{n}</td></tr>"
+                        for t, n in snap["queued"].items())
+        crows = "".join(f"<tr><td>{k}</td><td>{v}</td></tr>"
+                        for k, v in snap["counters"].items()) \
+            or "<tr><td colspan=2>none</td></tr>"
+        qd = snap["queue_delay"] or {}
+        return 200, (
+            "<html><head><title>gb admission</title></head><body>"
+            "<h1>admission</h1>"
+            f"<p>inflight {snap['inflight']}/{snap['max_inflight']}"
+            f" &middot; queued {snap['queued_total']}"
+            f"/{snap['max_queue']}"
+            f" &middot; svc EWMA {snap['svc_ewma_ms']} ms"
+            f" &middot; admitted {snap['admitted_total']}"
+            f" &middot; shed {snap['shed_total']}</p>"
+            "<table border=1><tr><th>tier</th><th>queued</th></tr>"
+            f"{qrows}</table>"
+            f"<h2>queue delay</h2><p>{json.dumps(qd)}</p>"
+            f"<h2>counters</h2><table border=1>{crows}</table>"
+            "</body></html>"), "text/html"
 
     def _page_mem(self, query: dict) -> tuple[int, str, str]:
         """Live memory-budget breakdown (the PageStats mem table +
@@ -1492,13 +1660,22 @@ class SearchHTTPServer:
                     nice = int(self.headers.get("X-Niceness") or 0)
                 except ValueError:
                     nice = 0
+                # a scatter leg carries its coordinator's tier verdict
+                tier = priority_mod.tier_from_header(
+                    self.headers.get(priority_mod.PRIORITY_HEADER))
                 status, payload, ctype = outer.handle(
                     method, parsed.path, query, body,
-                    client_ip=self.client_address[0], niceness=nice)
+                    client_ip=self.client_address[0], niceness=nice,
+                    tier=tier)
                 data = payload.encode("utf-8")
                 self.send_response(status)
                 self.send_header("Content-Type", ctype + "; charset=utf-8")
                 self.send_header("Content-Length", str(len(data)))
+                # shed 503s stash Retry-After on the side channel
+                # (handle() runs on this thread, so the contextvar set
+                # inside it is visible here)
+                for hname, hval in admission_mod.pop_response_headers():
+                    self.send_header(hname, hval)
                 self.end_headers()
                 self.wfile.write(data)
 

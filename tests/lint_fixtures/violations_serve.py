@@ -1,0 +1,28 @@
+# osselint: path=open_source_search_engine_tpu/serve/fixture_routes.py
+# osselint fixture — the pragma re-scopes this file to serve/, where
+# the admission-bypass rule applies: routes must go through
+# AdmissionGate.admit() before handing work to the dispatch planes
+# (QueryBatcher / ResidentLoop). Never scanned by the real linter
+# (lint_fixtures/ is excluded from walks).
+from ..query.engine import get_resident_loop
+
+
+def page_search_bad(self, query, q):
+    # handing the batcher work straight from a route: no tier, no
+    # bound, no shed accounting
+    return self._batcher.search(("main", 10, 0), q)  # EXPECT admission-bypass
+
+
+def page_direct_resident_bad(coll, plans):
+    return get_resident_loop(coll).submit(plans)  # EXPECT admission-bypass
+
+
+def page_tainted_resident_bad(coll, plans):
+    loop = get_resident_loop(coll)
+    return loop.submit(plans)  # EXPECT admission-bypass
+
+
+def _render_search(self, query, q, n, s):
+    # the sanctioned call site: runs under the admitted token the
+    # serve edge took from AdmissionGate.admit()
+    return self._batcher.search((query.get("c", "main"), n, s), q)

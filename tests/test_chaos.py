@@ -42,6 +42,8 @@ from open_source_search_engine_tpu.utils.membudget import MemBudget
 from open_source_search_engine_tpu.utils.stats import g_stats
 from open_source_search_engine_tpu.utils.trace import g_tracer
 
+from .polling import wait_until
+
 
 @pytest.fixture(autouse=True)
 def _chaos_reset():
@@ -352,7 +354,14 @@ class TestServeEdge:
         coll.conf.result_cache_ttl = 0.05
         code, page, _ = _search(srv, q="serve corpus")
         assert code == 200  # primed the result cache
-        time.sleep(0.12)    # ...and let the entry expire in place
+        # ...and poll until the entry expires in place (lookup counts
+        # the miss without evicting, so lookup_stale still finds it) —
+        # a fixed sleep here flakes on loaded boxes
+        gen = srv._result_gen(coll)
+        ckey = ("main", "serve corpus", 10, 0, "json")
+        wait_until(
+            lambda: not srv._result_cache.lookup(ckey, gen=gen)[0],
+            timeout=2.0, desc="result cache entry expiry")
 
         def timed_out_render(*a, **kw):
             raise DeadlineExceeded("chaos: render over budget")

@@ -41,4 +41,12 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_lint.py \
 #    the burn math is finite, and scrape overhead stays under 1% of
 #    query wall time
 BENCH_SLO=1 JAX_PLATFORMS=cpu python bench.py
+
+# 5. admission smoke: a SHORT open-loop sweep at low qps (generous
+#    latency bounds — this is a CI box, not a perf rig); exits nonzero
+#    unless overload sheds tier-correctly, every shed is counted, and
+#    the queue drains post-burst (bench.py main_load docstring)
+BENCH_LOAD=1 BENCH_LOAD_QPS=6,12 BENCH_LOAD_SECONDS=2 \
+    BENCH_LOAD_P99_MS=2000 BENCH_LOAD_OVER_P99_MS=3000 \
+    JAX_PLATFORMS=cpu python bench.py
 echo "check.sh: OK"

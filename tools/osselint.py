@@ -979,6 +979,76 @@ def rule_adhoc_timing(ctx: Ctx) -> list[Finding]:
     return out
 
 
+def _admission_scope(rel: str) -> bool:
+    """serve/ routes only — admission.py IS the gate, and the other
+    planes (query/, parallel/) sit below it by design."""
+    return rel.startswith(f"{PKG}/serve/") \
+        and not rel.endswith("/admission.py")
+
+
+#: serve/ functions allowed to touch the dispatch planes directly:
+#: the one call site that runs AFTER AdmissionGate.admit()
+_ADMISSION_SANCTIONED = {"_render_search"}
+
+
+def rule_admission_bypass(ctx: Ctx) -> list[Finding]:
+    """Dispatch-plane calls from serve/ that skip the admission gate.
+
+    ``_batcher.search(...)`` / ``get_resident_loop(...).submit(...)``
+    from a serve route hands work to the device planes without
+    admission control — under overload that path grows an unbounded
+    queue and bypasses the tier/shed accounting the load gates assert
+    on. Route through ``AdmissionGate.admit()`` first (the sanctioned
+    call site is ``_render_search``, which runs under the admitted
+    token)."""
+    #: names bound from get_resident_loop(...) anywhere in the file —
+    #: one hop of dataflow catches `loop = get_resident_loop(c)`
+    tainted: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call) \
+                and _final_ident(node.value.func) \
+                == "get_resident_loop":
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    tainted.add(t.id)
+
+    def bypasses(node: ast.Call) -> str | None:
+        if not isinstance(node.func, ast.Attribute):
+            return None
+        val = node.func.value
+        chain = dotted(val) or ""
+        if node.func.attr == "search" \
+                and chain.endswith("_batcher"):
+            return f"{chain}.search()"
+        if node.func.attr == "submit" and (
+                "resident" in chain
+                or (isinstance(val, ast.Call)
+                    and _final_ident(val.func) == "get_resident_loop")
+                or (isinstance(val, ast.Name)
+                    and val.id in tainted)):
+            return "resident submit()"
+        return None
+
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        hit = bypasses(node)
+        if hit is None:
+            continue
+        fn = _enclosing_function(ctx, node)
+        if fn is not None and fn.name in _ADMISSION_SANCTIONED:
+            continue
+        out.append(Finding(
+            ctx.rel, node.lineno, "admission-bypass",
+            f"{hit} from a serve route skips the admission gate — "
+            "unbounded queueing and untiered overload; go through "
+            "AdmissionGate.admit() (only _render_search may touch "
+            "the dispatch planes directly)"))
+    return out
+
+
 #: (rule-name, path predicate, checker)
 RULES = [
     ("ttlcache-offplane", _ttl_scope, rule_ttlcache_offplane),
@@ -999,6 +1069,7 @@ RULES = [
      rule_jit_implicit_transfer),
     ("bare-deadline", _timed_scope, rule_bare_deadline),
     ("adhoc-timing", _timed_scope, rule_adhoc_timing),
+    ("admission-bypass", _admission_scope, rule_admission_bypass),
 ]
 
 RULE_NAMES = {name for name, _p, _c in RULES}
