@@ -101,6 +101,21 @@ def _init_backend(max_tries: int = 3):
     raise last  # type: ignore[misc]
 
 
+def _backend_record() -> dict:
+    """The resolved JAX backend stamped into every BENCH_* JSON line —
+    a TPU-measured point and a CPU-fallback point must never be
+    confused when curves span runs. ``device_measured`` is True only
+    when the run actually resolved a TPU backend; a CPU fallback (or a
+    backend that never initialized) marks the numbers host-measured."""
+    try:
+        import jax
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — backend never initialized
+        return {"backend": "none", "device_measured": False}
+    return {"backend": str(backend),
+            "device_measured": str(backend) == "tpu"}
+
+
 def _emit_stale_curve(reason: str) -> None:
     """Persistent backend failure: print the last-good cached scale
     curve marked ``"stale": true`` and exit 0 — a parseable
@@ -115,6 +130,8 @@ def _emit_stale_curve(reason: str) -> None:
         "unit": "qps",
         "vs_baseline": round(qps / BASELINE_QPS, 2),
         "stale": True,
+        **_backend_record(),
+        "device_measured": False,  # cached numbers, not this run's
         "error": reason[:300],
         "docs": latest.get("docs", 0),
         "scale": curve,
@@ -205,6 +222,7 @@ def main_mesh(n_shards: int) -> None:
     elapsed = time.perf_counter() - t0
     qps = (len(qs) - 16) / elapsed
     print(json.dumps({
+        **_backend_record(),
         "metric": "queries_per_sec_mesh_cpu_validation",
         "value": round(qps, 2), "unit": "qps",
         "vs_baseline": 0.0, "n_shards": n_shards, "docs": n_docs,
@@ -293,6 +311,7 @@ def main_transport() -> None:
     hedge_lats.sort()
     ride_lats.sort()
     print(json.dumps({
+        **_backend_record(),
         "metric": "transport_rpc_per_sec_pooled",
         "value": pooled["rpc_s"], "unit": "rpc/s",
         "vs_baseline": round(pooled["rpc_s"] / max(dialed["rpc_s"], 1e-9),
@@ -389,6 +408,7 @@ def main_cache() -> None:
     speedup = round(uncached["repeat_p50_ms"]
                     / max(cached["repeat_p50_ms"], 1e-9), 2)
     print(json.dumps({
+        **_backend_record(),
         "metric": "cache_hot_query_p50_speedup",
         "value": speedup, "unit": "x", "vs_baseline": speedup,
         "queries": n_q, "distinct": len(distinct),
@@ -451,6 +471,7 @@ def main_trace() -> None:
 
     ok = overhead < 0.02
     print(json.dumps({
+        **_backend_record(),
         "metric": "trace_unsampled_overhead_pct",
         "value": round(100.0 * overhead, 3), "unit": "%",
         "ok": ok, "budget_pct": 2.0,
@@ -535,6 +556,7 @@ def main_dispatch() -> None:
 
     ok = ratio <= 0.7
     print(json.dumps({
+        **_backend_record(),
         "metric": "dispatch_enqueue_to_result_p50_ms",
         "value": round(p50, 2), "unit": "ms",
         "p99_ms": round(p99, 2),
@@ -616,6 +638,7 @@ def main_jit() -> None:
           and t["transfers_offboundary"] == 0)
     lats.sort()
     print(json.dumps({
+        **_backend_record(),
         "metric": "jit_steady_state_compiles",
         "value": t["compiles"], "unit": "compiles",
         "waves": n_waves,
@@ -869,6 +892,7 @@ def main() -> None:
         "replay_n": len(meas_qs),
         "docs": N_DOCS,
         "scale": curve,
+        **_backend_record(),
     }))
     # --- stage breakdown (always on): where the measured time went
     # (snap taken right after the throughput pass) ---
@@ -1160,6 +1184,7 @@ def main_soak() -> dict:
         "counters": {k: v for k, v in sorted(c.items())
                      if k.startswith(keep)},
     }
+    rep.update(_backend_record())
     print(json.dumps(rep))
     for n in nodes:
         n.stop()
@@ -1261,6 +1286,7 @@ def main_slo() -> dict:
         "scrape_overhead_pct": round(100.0 * overhead, 3),
         "wall_s": round(wall, 2),
     }
+    rep.update(_backend_record())
     print(json.dumps(rep))
     client.close()
     for n in nodes:
@@ -1492,6 +1518,7 @@ def main_load() -> dict:
         "capacity_est_qps": round(capacity, 1),
         "sweep": legs, "overload": over, "recovery": recovery,
     }
+    rep.update(_backend_record())
     print(json.dumps(rep))
     pool.shutdown(wait=False)
     g_chaos.disable()
@@ -1499,6 +1526,266 @@ def main_load() -> dict:
     client.close()
     for n in nodes:
         n.stop()
+    return rep
+
+
+def main_fleet() -> dict:
+    """Fleet gate (BENCH_FLEET=1): a 2-shard × 2-twin fleet of REAL OS
+    processes (``parallel.fleet.FleetManager``) serves an open-loop
+    Zipf query stream while the legs fire in sequence:
+
+    1. survive-the-primary: mid-load writes land (acked + journaled on
+       every twin), then chaos WEDGES (SIGSTOP, the ``fleet.wedge``
+       seam) shard 0's primary so in-flight requests sit silently —
+       the transport's hedge timer must fire and the twin must win,
+       with zero lost responses — and finally kills the wedged process
+       for real (SIGKILL, the ``fleet.kill`` seam);
+    2. rejoin: the supervisor respawns the corpse from its checkpoint
+       dir; journal replay must conserve every acked doc (twin
+       equality AND fleet total) and the scrape must see all hosts up;
+    3. rolling restart under load: every node drains through its
+       admission gate, checkpoints via /rpc/save, restarts — p99 stays
+       inside BENCH_FLEET_P99_MS, nothing is lost, every node reports
+       drained+saved;
+    4. parm broadcast: applied on every node live (pids unchanged —
+       the reference's 0x3f update, no restarts);
+    5. shard split, cross-process: after teardown the fleet's on-disk
+       grid re-shards 2 → 3 via control.rebalance, docs conserved.
+
+    Exits 1 unless EVERY gate holds. Prints ONE JSON line."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import random
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    from open_source_search_engine_tpu.control.rebalance import rebalance
+    from open_source_search_engine_tpu.parallel import cluster as cl
+    from open_source_search_engine_tpu.parallel.fleet import FleetManager
+    from open_source_search_engine_tpu.utils.chaos import g_chaos
+    from open_source_search_engine_tpu.utils.stats import g_stats
+
+    g_stats.reset()
+    bdir = tempfile.mkdtemp(prefix="osse_bench_fleet_")
+    n_docs = int(os.environ.get("BENCH_FLEET_DOCS", "12"))
+    n_mid = int(os.environ.get("BENCH_FLEET_MID_WRITES", "4"))
+    qps = float(os.environ.get("BENCH_FLEET_QPS", "10"))
+    leg_s = float(os.environ.get("BENCH_FLEET_SECONDS", "8"))
+    p99_ms = float(os.environ.get("BENCH_FLEET_P99_MS", "5000"))
+    workers = int(os.environ.get("BENCH_FLEET_WORKERS", "16"))
+
+    vocab = ("alpha bravo charlie delta echo foxtrot golf hotel "
+             "india juliet kilo lima").split()
+
+    def html_of(d: int) -> str:
+        words = " ".join(vocab[(d + j) % len(vocab)] for j in range(5))
+        return (f"<html><head><title>Fleet doc {d}</title></head>"
+                f"<body><p>{words} token{d}</p></body></html>")
+
+    grid_dir = os.path.join(bdir, "grid")
+    fm = FleetManager(grid_dir, n_shards=2, n_replicas=2,
+                      chaos_seed=11)
+    g_chaos.enable(11, rate=0.0)  # parent seams armed, aimed-only
+    pool = ThreadPoolExecutor(workers)
+    lock = threading.Lock()
+    rng = random.Random(7)
+    try:
+        fm.start_all()
+        client = cl.ClusterClient(fm.conf, use_heartbeat=False)
+        for d in range(n_docs):
+            client.index_document(f"http://fleet.test/{d}", html_of(d))
+        seeded_ok = client.pending_writes == 0
+
+        # warm every node's query path DIRECTLY (first /rpc/search
+        # compiles ~1s; it must inflate neither the hedge EWMA nor a
+        # timed leg), then pin the twin order: replica 0 primary
+        for addr in fm.addrs():
+            client.transport.request(addr, "/rpc/search",
+                                     {"q": "alpha bravo", "topk": 5},
+                                     timeout=120.0)
+        client.search("alpha bravo", topk=5, site_cluster=False)
+        for s in range(fm.n_shards):
+            client.hostmap.rtt_s[s, 0] = 0.001
+            client.hostmap.rtt_s[s, 1] = 0.002
+
+        distinct = vocab + [f"token{d}" for d in range(n_docs)]
+        zipf_w = [1.0 / (r + 1) ** 1.1 for r in range(len(distinct))]
+
+        def run_leg(seconds: float, during=(), stop_when=None) -> dict:
+            """Open-loop Poisson arrivals at ``qps``; each ``during``
+            entry ``(frac, fn)`` fires once as the leg crosses that
+            fraction of its span. A lost response (exception out of
+            the hedged scatter) is the bug this gate exists to catch."""
+            lats: list[float] = []
+            counts = {"ok": 0, "degraded": 0, "lost": 0}
+            events = sorted(during)
+            futs = []
+
+            def one(qstr: str) -> None:
+                t0 = time.monotonic()
+                try:
+                    res = client.search(qstr, topk=5,
+                                        site_cluster=False)
+                    key = "degraded" if res.degraded else "ok"
+                except Exception:  # noqa: BLE001 — a lost reply
+                    key = "lost"
+                dt = time.monotonic() - t0
+                with lock:
+                    counts[key] += 1
+                    lats.append(dt)
+
+            t_start = time.monotonic()
+            end = t_start + seconds
+            t_next = t_start
+            arrivals = 0
+            ei = 0
+            while t_next < end and not (stop_when and stop_when()):
+                now = time.monotonic()
+                if t_next > now:
+                    time.sleep(t_next - now)
+                frac = (time.monotonic() - t_start) / seconds
+                while ei < len(events) and frac >= events[ei][0]:
+                    events[ei][1]()
+                    ei += 1
+                q = " ".join(rng.choices(distinct, weights=zipf_w,
+                                         k=2))
+                futs.append(pool.submit(one, q))
+                arrivals += 1
+                t_next += rng.expovariate(qps)
+            for f in futs:
+                f.result()
+            while ei < len(events):  # leg too short for an event frac
+                events[ei][1]()
+                ei += 1
+            p99 = (float(np.percentile(np.asarray(lats) * 1000.0, 99))
+                   if lats else 0.0)
+            return {"arrivals": arrivals, **counts,
+                    "p99_ms": round(p99, 1)}
+
+        # --- leg 1: mid-load writes → wedge → real SIGKILL ---------------
+        prey: dict = {}
+
+        def mid_writes() -> None:
+            for d in range(n_docs, n_docs + n_mid):
+                client.index_document(f"http://fleet.test/{d}",
+                                      html_of(d))
+
+        def wedge_primary() -> None:
+            g_chaos.configure("fleet", rate=1.0, kinds=("wedge",))
+            prey["pid"] = fm.pid(0, 0)
+            prey["wedge"] = g_chaos.fleet_fault(prey["pid"])
+
+        def kill_primary() -> None:
+            g_chaos.configure("fleet", rate=1.0, kinds=("kill",))
+            prey["kill"] = g_chaos.fleet_fault(prey["pid"])
+
+        c0 = g_stats.snapshot()["counters"]
+        leg1 = run_leg(leg_s, during=[(0.25, mid_writes),
+                                      (0.45, wedge_primary),
+                                      (0.70, kill_primary)])
+        c1 = g_stats.snapshot()["counters"]
+        hedge_fired = (c1.get("transport.hedge_fired", 0)
+                       - c0.get("transport.hedge_fired", 0))
+        hedge_won = (c1.get("transport.hedge_won", 0)
+                     - c0.get("transport.hedge_won", 0))
+
+        # --- leg 2: supervisor respawn + journal replay + rejoin ---------
+        ping00 = fm.wait_ready(0, 0, timeout_s=60.0)
+        ping01 = fm.transport.request(fm.addr(0, 1), "/rpc/ping", {},
+                                      timeout=10.0)
+        ping10 = fm.transport.request(fm.addr(1, 0), "/rpc/ping", {},
+                                      timeout=10.0)
+        total_docs = n_docs + n_mid
+        docs_conserved = (ping00["docs"] == ping01["docs"]
+                          and ping00["docs"] + ping10["docs"]
+                          == total_docs)
+        def hosts_up_now() -> int:
+            sc = client.scrape()
+            return sum(1 for w in sc["hosts"].values()
+                       if w is not None)
+
+        # the first scrape after a respawn can ride a pooled connection
+        # that died with the old process — re-scrape briefly before
+        # calling a host down (a scrape is a read, not a liveness
+        # verdict)
+        hosts_up = hosts_up_now()
+        scrape_end = time.monotonic() + 15.0
+        while (hosts_up < fm.n_shards * fm.n_replicas
+               and time.monotonic() < scrape_end):
+            time.sleep(0.25)
+            hosts_up = hosts_up_now()
+
+        # --- leg 3: rolling restart under load ---------------------------
+        roll: dict = {}
+
+        def do_roll() -> None:
+            roll.update(fm.rolling_restart(drain_timeout_s=5.0))
+
+        roll_fut = pool.submit(do_roll)
+        leg3 = run_leg(120.0, stop_when=roll_fut.done)
+        roll_fut.result()
+        roll_ok = bool(roll.get("nodes")) and all(
+            n["drained"] and n["saved"] for n in roll["nodes"])
+
+        # --- leg 4: live parm broadcast (no restarts) --------------------
+        pids_before = dict(fm.pids())
+        replies = fm.broadcast_parms({"spider_delay_ms": 4321})
+        parm_applied = all(
+            r is not None and r.get("ok")
+            and "spider_delay_ms" in r.get("applied", [])
+            for r in replies.values())
+        conf_ok = all(
+            (fm.transport.request(a, "/rpc/conf", {}, timeout=10.0)
+             or {}).get("conf", {}).get("spider_delay_ms") == 4321
+            for a in fm.addrs())
+        parm_no_restart = dict(fm.pids()) == pids_before
+
+        client.close()
+    finally:
+        fm.shutdown()
+        g_chaos.disable()
+        pool.shutdown(wait=False)
+    reaped = fm.surviving_pids() == []
+
+    # --- leg 5: cross-process shard split on the shut-down grid ---------
+    sc = rebalance("shard", grid_dir, os.path.join(bdir, "regrid"),
+                   2, 3)
+    rebalance_docs = int(sc.num_docs)
+
+    gates = {
+        "seed_writes_acked": seeded_ok,
+        "kill_leg_zero_lost": leg1["lost"] == 0
+        and leg1["degraded"] == 0,
+        "wedge_hedge_fired_and_won": prey.get("wedge") == "wedge"
+        and hedge_fired > 0 and hedge_won > 0,
+        "killed_for_real": prey.get("kill") == "kill",
+        "rejoin_replayed_docs_conserved": docs_conserved,
+        "rejoin_new_pid": ping00["pid"] != prey.get("pid"),
+        "scrape_all_hosts_up": hosts_up
+        == fm.n_shards * fm.n_replicas,
+        "rolling_restart_drained_and_saved": roll_ok,
+        "rolling_restart_zero_lost": leg3["lost"] == 0
+        and leg3["degraded"] == 0,
+        "rolling_restart_p99_in_slo": 0 < leg3["p99_ms"] < p99_ms,
+        "parm_applied_everywhere": parm_applied and conf_ok,
+        "parm_without_restart": parm_no_restart,
+        "teardown_no_orphans": reaped,
+        "rebalance_docs_conserved": rebalance_docs == total_docs,
+    }
+    ok = all(gates.values())
+    rep = {
+        "metric": "fleet_gate",
+        "value": sum(bool(v) for v in gates.values()),
+        "unit": f"gates_passed_of_{len(gates)}",
+        "ok": ok, "gates": gates,
+        "kill_leg": leg1, "roll_leg": leg3, "roll": roll,
+        "hedge_fired": hedge_fired, "hedge_won": hedge_won,
+        "hosts_up": hosts_up, "sheds": roll.get("sheds", 0),
+        "docs_total": total_docs, "rebalance_docs": rebalance_docs,
+    }
+    rep.update(_backend_record())
+    print(json.dumps(rep))
     return rep
 
 
@@ -1521,5 +1808,7 @@ if __name__ == "__main__":
         sys.exit(0 if main_slo()["ok"] else 1)
     elif os.environ.get("BENCH_LOAD"):
         sys.exit(0 if main_load()["ok"] else 1)
+    elif os.environ.get("BENCH_FLEET"):
+        sys.exit(0 if main_fleet()["ok"] else 1)
     else:
         main()

@@ -175,16 +175,28 @@ def cmd_crawl(args) -> int:
 
 def cmd_node(args) -> int:
     """Run one shard-replica node process (the cluster's unit — the
-    reference's per-host gb instance; RPC surface in parallel.cluster)."""
+    reference's per-host gb instance; RPC surface in parallel.cluster).
+    A fleet supervisor spawns this verb once per (shard, replica) with
+    the serialized cluster map (`--hosts`), the node's seat in it, and
+    the chaos seed in OSSE_CHAOS — the child arms its own seams so a
+    cross-process fault schedule replays deterministically."""
+    import os
     import signal
 
-    from .parallel.cluster import ShardNodeServer
+    from .parallel.cluster import HostsConf, ShardNodeServer
+    from .utils import chaos as chaos_mod
 
+    chaos_mod.maybe_enable()
+    cluster_map = HostsConf.load(args.hosts) if args.hosts else None
     node = ShardNodeServer(args.dir, host=args.host, port=args.port,
-                           use_device=args.device)
+                           use_device=args.device, shard=args.shard,
+                           replica=args.replica,
+                           cluster_map=cluster_map)
     node.start()
     print(json.dumps({"node": f"{args.host}:{node.port}",
-                      "docs": node.coll.num_docs}), flush=True)
+                      "docs": node.coll.num_docs,
+                      "shard": args.shard, "replica": args.replica,
+                      "pid": os.getpid()}), flush=True)
     stop = [False]
 
     def handler(signum, frame):
@@ -292,6 +304,13 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--device", action="store_true",
                    help="serve queries from the HBM-resident index")
+    p.add_argument("--hosts", help="hosts.conf cluster map handed out "
+                   "at spawn (Hostdb: every instance boots knowing "
+                   "the topology)")
+    p.add_argument("--shard", type=int, default=0,
+                   help="this node's shard id in the map")
+    p.add_argument("--replica", type=int, default=0,
+                   help="this node's twin id within the shard")
     p.set_defaults(fn=cmd_node)
 
     p = sub.add_parser("proxy", help="query-routing front proxy "

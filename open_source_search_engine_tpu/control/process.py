@@ -91,12 +91,31 @@ class Process:
         signal.signal(signal.SIGINT, handler)
 
 
+def make_rpc_probe(conf, transport=None, timeout_s: float = 1.5):
+    """A :class:`Heartbeat` probe that pings real node processes:
+    ``probe(shard) -> bool`` hits ``/rpc/ping`` on each of the shard's
+    twins over the pooled transport and reports the shard alive when
+    ANY twin answers — the cross-process PingServer, for fleets spawned
+    by ``parallel.fleet.FleetManager`` from the same hosts.conf map."""
+    def probe(shard: int) -> bool:
+        # runtime import: control/ must not pull the transport stack
+        # (and its jax-adjacent deps) at module import time
+        from ..parallel import transport as transport_mod
+
+        t = transport or transport_mod.g_transport
+        return any(t.probe(addr, timeout=timeout_s) is not None
+                   for addr in conf.addresses[shard])
+
+    return probe
+
+
 class Heartbeat:
     """Shard liveness prober (PingServer: ``sendPingsToAll``
     ``PingServer.h:61`` + dead marking feeding Multicast failover).
 
-    In-process shards don't die independently, so the probe is pluggable:
-    multi-host deployments give ``probe(shard_id) -> bool`` an RPC ping;
+    In-process shards don't die independently, so the probe is
+    pluggable: multi-host deployments hand ``probe(shard_id) -> bool``
+    a real RPC ping (:func:`make_rpc_probe` over a hosts.conf map);
     tests flip it to simulate failures. Dead shards are skipped by the
     query path (degraded serving) until they pass a probe again.
     """

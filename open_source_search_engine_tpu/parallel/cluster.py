@@ -135,8 +135,18 @@ class ShardNodeServer:
 
     def __init__(self, data_dir: str | Path, host: str = "127.0.0.1",
                  port: int = 0, use_device: bool = False,
-                 use_cache: bool = True):
+                 use_cache: bool = True, shard: int = 0,
+                 replica: int = 0,
+                 cluster_map: "HostsConf | None" = None):
         self.coll = Collection("shard", data_dir)
+        #: this node's seat in the fleet and the Hostdb-style map it was
+        #: handed at spawn (hosts.conf semantics: every gb instance
+        #: boots knowing the whole topology, Hostdb.cpp:124) — lets the
+        #: node name its twins for heal pulls and report its identity
+        #: on /rpc/ping so the supervisor can verify placement
+        self.shard = int(shard)
+        self.replica = int(replica)
+        self.cluster_map = cluster_map
         # per-shard results feed the CLIENT-side merge, which applies
         # PostQueryRerank once over the merged page — node-side PQR
         # would demote twice and skew the cross-shard merge
@@ -166,6 +176,7 @@ class ShardNodeServer:
         # SIGKILL'd node recovers every acked write
         self._journal_path = Path(data_dir) / "addsinprogress.jsonl"
         self._replay_journal()
+        self._recount_docs()
         self._journal = open(self._journal_path, "a",  # noqa: SIM115
                              encoding="utf-8")
         self._writes_since_save = 0
@@ -191,6 +202,18 @@ class ShardNodeServer:
         #: private Stats per node so a scrape-merge is a real merge
         #: instead of the singleton merged with itself
         self.stats_registry = g_stats
+        #: per-node admission door on the data-plane RPCs. Configured as
+        #: a pure capacity + drain gate (the SLO/membudget degrade
+        #: ladder stays at the coordinator, so the signal fns are off):
+        #: its job here is bounding concurrent work per process and
+        #: being the point a rolling restart closes before checkpoint.
+        #: Runtime-layer import: parallel/ stays import-light on serve/
+        #: (the tier vocabulary already lives in utils/priority).
+        from ..serve.admission import AdmissionGate
+        self.admission = AdmissionGate(max_inflight=64, max_queue=512,
+                                       max_wait_s=5.0,
+                                       degraded_fn=lambda: False,
+                                       pressure_fn=lambda: False)
 
     def _replay_journal(self) -> None:
         from ..build import docproc
@@ -215,6 +238,26 @@ class ShardNodeServer:
         if n:
             log.info("replayed %d journaled adds", n)
 
+    def _recount_docs(self) -> None:
+        """collstats.json is save-time state — a kill -9 loses it even
+        though BOTH journal layers (rdblite's addsinprogress + ours)
+        restore every acked record, and replaying an add whose titlerec
+        survived is a replace that never re-counts. On boot, trust the
+        Rdbs: the live doc count is the merged titledb's positive
+        keys."""
+        from ..index import titledb as titledb_mod
+
+        batch = self.coll.titledb.get_all()
+        n = 0
+        if len(batch):
+            n = int((titledb_mod.unpack_key(batch.keys)["delbit"]
+                     == 1).sum())
+        if n != self.coll.num_docs:
+            log.info("doc count recomputed from titledb: %d "
+                     "(collstats said %d)", n, self.coll.num_docs)
+            self.coll.num_docs = n
+            self.coll._save_stats()
+
     def _journal_write(self, rec: dict) -> None:
         self._journal.write(json.dumps(rec) + "\n")
         self._journal.flush()
@@ -222,14 +265,54 @@ class ShardNodeServer:
 
     # --- request handlers -------------------------------------------------
 
+    #: data-plane routes pass the per-node admission door; control
+    #: routes (ping/stats/drain/save/parm[s]/heal) must keep answering
+    #: while the gate is draining — a rolling restart still needs to
+    #: probe, checkpoint, and observe the node it is about to stop
+    GATED_RPCS = frozenset({"/rpc/index", "/rpc/remove", "/rpc/search",
+                            "/rpc/doc", "/rpc/pull", "/rpc/pull-all"})
+
     def handle(self, path: str, payload: dict) -> dict:
+        if path == "/rpc/drain":
+            # stop admitting, let in-flight waves collect. Shed write
+            # RPCs reply ok=False, so they park in the coordinator's
+            # ordered twin queue and redeliver after the restart; shed
+            # reads 503 into the transport's instant twin failover.
+            self.admission.drain()
+            quiesced = self.admission.quiesce(
+                float(payload.get("timeout_s", 10.0)))
+            snap = self.admission.snapshot()
+            return {"ok": True, "drained": bool(quiesced),
+                    "inflight": snap["inflight"],
+                    "sheds": snap["shed_total"]}
+        if path == "/rpc/undrain":
+            self.admission.resume()
+            return {"ok": True}
+        if path in self.GATED_RPCS:
+            from ..serve.admission import Shed
+            tier = priority_mod.current_tier() or "interactive"
+            try:
+                ticket = self.admission.admit(
+                    tier, deadline_mod.current())
+            except Shed as e:
+                return {"ok": False, "error": f"shed:{e.reason}",
+                        "shed": e.reason,
+                        "retry_after_s": e.retry_after_s}
+            with ticket:
+                return self._handle(path, payload)
+        return self._handle(path, payload)
+
+    def _handle(self, path: str, payload: dict) -> dict:
         from ..build import docproc
         from ..query import engine
 
         if path == "/rpc/ping":
             # lock-free: a long write/checkpoint must not fail heartbeats
             return {"ok": True, "docs": self.coll.num_docs,
-                    "accepts": self.accepts}
+                    "accepts": self.accepts,
+                    "shard": self.shard, "replica": self.replica,
+                    "pid": os.getpid(),
+                    "draining": self.admission.draining}
         if path == "/rpc/conf":
             # read-only conf dump (ops + broadcast verification)
             return {"ok": True, "conf": self.coll.conf.to_dict()}
@@ -380,6 +463,32 @@ class ShardNodeServer:
                 log.info("parm %s=%r applied (seq %d)", name,
                          payload["value"], seq)
                 return {"ok": True}
+            if path == "/rpc/parms":
+                # bulk live-update (the whole `gb save`-style broadcast
+                # in one RPC): same per-name sequence dedup as
+                # /rpc/parm, one conf.save for the batch, applied with
+                # NO process restart — the reply carries this node's
+                # pid so the caller can prove that
+                seq = int(payload.get("seq", 0))
+                applied: list[str] = []
+                errors: dict[str, str] = {}
+                for name, value in dict(payload.get("parms",
+                                                    {})).items():
+                    if seq <= self._parm_seq.get(name, -1):
+                        continue
+                    try:
+                        self.coll.conf.set(name, value, _from_sync=True)
+                    except KeyError as e:
+                        errors[name] = str(e)
+                        continue
+                    self._parm_seq[name] = seq
+                    applied.append(name)
+                if applied:
+                    self.coll.conf.save(self.coll._conf_path)
+                    log.info("parms %s applied (seq %d)",
+                             ",".join(applied), seq)
+                return {"ok": not errors, "applied": applied,
+                        "errors": errors, "pid": os.getpid()}
             if path == "/rpc/pull":
                 # twin-patch send side (Msg5 error correction): ship one
                 # Rdb's full merged content to a healing sibling
@@ -1022,9 +1131,12 @@ class ClusterClient:
                        OSSE_ALERT_HOST=addr,
                        OSSE_ALERT_SHARD=str(shard),
                        OSSE_ALERT_REPLICA=str(replica))
-            subprocess.Popen(cmd, shell=True, env=env,
-                             stdout=subprocess.DEVNULL,
-                             stderr=subprocess.DEVNULL)
+            subprocess.Popen(  # osselint: ignore[proc-spawn] — the
+                # operator's pager hook (OSSE_ALERT_CMD) is an external
+                # command by design; it manages no fleet child
+                cmd, shell=True, env=env,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL)
         except Exception as e:  # noqa: BLE001 — alerting must not kill
             log.warning("alert_cmd failed: %s", e)
 

@@ -111,6 +111,18 @@ class HostMap:
                 return r
         return None
 
+    def hosts_up(self) -> int:
+        """Live host count across the whole grid (the fleet scrape's
+        ``cluster.scrape_hosts_up`` gauge, from this map's view)."""
+        return int(self.alive.sum())
+
+    def serving_ok(self) -> bool:
+        """Every shard has at least one alive twin — the availability
+        predicate a rolling restart must hold between node stops (take
+        one host down only while its twin can absorb the traffic)."""
+        return all(self.serving_replica(s) is not None
+                   for s in range(self.n_shards))
+
     def observe_rtt(self, shard: int, replica: int, dt_s: float) -> None:
         """Fold one completed read's latency into the twin's EWMA."""
         prev = self.rtt_s[shard, replica]

@@ -480,6 +480,19 @@ class Transport:
                                resp.headers.get("Content-Type", ""))
         raise AssertionError("unreachable")
 
+    def probe(self, addr: str, path: str = "/rpc/ping",
+              timeout: float = 1.5) -> dict | None:
+        """One quiet liveness probe: the reply dict when the peer
+        answers ``ok``, else ``None`` — never raises. Readiness polls
+        (a fleet child mid-boot) and heartbeats call this in a loop;
+        the normal error/fast-fail counters still move underneath, so
+        a flapping peer stays visible in the stats plane."""
+        try:
+            out = self.request(addr, path, {}, timeout=timeout)
+        except Exception:  # noqa: BLE001 — an absent peer is a None
+            return None
+        return out if out.get("ok") else None
+
     def broadcast(self, addrs: list[str], path: str, payload: dict,
                   timeout: float, niceness: int = 1
                   ) -> dict[str, dict | None]:

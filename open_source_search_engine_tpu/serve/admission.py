@@ -23,6 +23,12 @@ Shed triggers, in the order they are consulted:
    than timing out later (the metastable-collapse preventer: work that
    cannot finish in time never enters the queue).
 
+A gate can also be **drained** (``drain()``): every new arrival sheds
+with reason ``draining`` while admitted work runs to completion —
+the rolling-restart sequence the fleet plane uses (stop admitting,
+``quiesce()`` until in-flight waves collect, checkpoint, then kill the
+process; its twin absorbs the shed traffic via transport hedging).
+
 The tier vocabulary (names, header, contextvar) lives in
 ``utils/priority.py`` so ``parallel/`` can stamp scatter legs without
 importing the serve layer.
@@ -95,6 +101,7 @@ class AdmissionGate:
         self._pressure_fn = pressure_fn or self._mem_pressure
         self._cv = threading.Condition()
         self._inflight = 0
+        self._draining = False
         self._waiting: dict[str, deque] = {t: deque() for t in TIERS}
         #: EWMA of admitted service time (s) — the queue-delay
         #: predictor's clock; seeded pessimistically so a cold gate
@@ -119,6 +126,11 @@ class AdmissionGate:
             tier = "interactive"
         t_enq = time.perf_counter()
         with self._cv:
+            if self._draining:
+                # draining gates shed unconditionally — cheaper for the
+                # caller to hedge to the twin than to queue behind a
+                # node that is about to checkpoint and exit
+                raise self._shed_locked(tier, "draining")
             n_wait = sum(len(q) for q in self._waiting.values())
             if n_wait >= self.max_queue:
                 g_stats.count("admission.queue_full")
@@ -173,7 +185,7 @@ class AdmissionGate:
         budget = deadline_mod.Deadline.after(self.max_wait_s)
         if deadline is not None and deadline.at < budget.at:
             budget = deadline
-        while not w["go"]:
+        while not w["go"] and not self._draining:
             left = budget.remaining()
             if left <= 0:
                 break
@@ -181,6 +193,8 @@ class AdmissionGate:
         if not w["go"]:
             # grant pops under this lock, so un-granted => still queued
             self._waiting[tier].remove(w)
+            if self._draining:
+                raise self._shed_locked(tier, "draining")
             raise self._shed_locked(
                 tier, "deadline" if deadline is not None
                 and deadline.expired() else "timeout")
@@ -211,12 +225,46 @@ class AdmissionGate:
             w["go"] = True
             self._inflight += 1
 
+    # --- drain (rolling-restart sequencing) -------------------------------
+
+    def drain(self) -> None:
+        """Stop admitting: new arrivals (and queued waiters) shed with
+        reason ``draining``; work already admitted runs to completion.
+        Idempotent."""
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+        g_stats.count("admission.drain")
+
+    def resume(self) -> None:
+        """Reopen a drained gate (operator aborted the restart)."""
+        with self._cv:
+            self._draining = False
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def quiesce(self, timeout_s: float = 10.0) -> bool:
+        """Block until nothing is admitted and nothing waits — the
+        let-in-flight-waves-collect step between ``drain()`` and the
+        checkpoint. False if the gate did not empty in time."""
+        dl = deadline_mod.Deadline.after(float(timeout_s))
+        with self._cv:
+            while self._inflight > 0 or any(
+                    self._waiting[t] for t in TIERS):
+                if dl.expired():
+                    return False
+                self._cv.wait(dl.clamp(0.05))
+            return True
+
     # --- observability ----------------------------------------------------
 
     def snapshot(self) -> dict:
         with self._cv:
             return {
                 "inflight": self._inflight,
+                "draining": self._draining,
                 "max_inflight": self.max_inflight,
                 "max_queue": self.max_queue,
                 "queued": {t: len(self._waiting[t]) for t in TIERS},

@@ -19,6 +19,10 @@ rdb.read            flip bytes in a posting run on disk so CRC verify /
 membudget.reserve   force a pressure pass so caches shed before work is
                     refused (the OOM merge defer)
 resident.loop       stall a wave / drop a collect
+fleet               REAL process faults on a spawned node: kill
+                    (SIGKILL — recovery is journal replay, not a
+                    politely-stopped server) / wedge (SIGSTOP — the
+                    held-reply case, the hedge must eat it)
 ==================  =====================================================
 
 Arming: ``OSSE_CHAOS=<seed>`` in the environment (``maybe_enable`` at
@@ -60,6 +64,7 @@ DEFAULT_POINTS: dict[str, tuple[str, ...]] = {
     "rdb.read": ("flipbyte",),
     "membudget.reserve": ("pressure",),
     "resident.loop": ("stall", "drop_collect"),
+    "fleet": ("kill", "wedge"),
 }
 
 
@@ -245,6 +250,36 @@ class ChaosPlane:
         log.info("chaos: flipped byte %d of %s", off, target)
         return str(target)
 
+    def fleet_fault(self, pid: int, key: str = "") -> str | None:
+        """fleet: a REAL signal to a spawned node process — ``kill``
+        is SIGKILL (no atexit, no save; the node's next life must
+        recover every acked write from its journal) and ``wedge`` is
+        SIGSTOP (sockets stay open, replies never come — the
+        transport's hedge timer, not an error failover, has to eat the
+        in-flight requests). Returns the kind fired, or None."""
+        import signal
+
+        kind = self.decide("fleet", key=key or str(pid))
+        if kind is None:
+            return None
+        sig = signal.SIGKILL if kind == "kill" else signal.SIGSTOP
+        try:
+            os.kill(int(pid), sig)
+            log.info("chaos: fleet %s pid=%d", kind, pid)
+        except ProcessLookupError:
+            log.warning("chaos: fleet %s pid=%d already gone", kind,
+                        pid)
+        return kind
+
+    def fleet_resume(self, pid: int) -> None:
+        """SIGCONT a wedged node (the operator un-sticking a host)."""
+        import signal
+
+        try:
+            os.kill(int(pid), signal.SIGCONT)
+        except ProcessLookupError:
+            pass
+
     def resident_fault(self, where: str) -> None:
         """resident.loop: stall an issue/collect, or drop a collect
         (raises; the loop fails that wave's tickets and the layer above
@@ -266,7 +301,12 @@ g_chaos = ChaosPlane()
 
 def maybe_enable() -> bool:
     """Arm from ``OSSE_CHAOS=<seed>`` if set (call at server startup —
-    never on a hot path). Returns True when armed."""
+    never on a hot path). Returns True when armed.
+
+    ``OSSE_CHAOS_RATE`` (float) overrides the default ambient fault
+    rate — the fleet supervisor hands children ``OSSE_CHAOS`` with
+    rate 0 so their seams are armed and replayable but only faults the
+    parent *aims* (via configure()/the fleet seams) ever fire."""
     v = os.environ.get("OSSE_CHAOS", "")
     if not v:
         return False
@@ -275,5 +315,9 @@ def maybe_enable() -> bool:
     except ValueError:
         log.warning("OSSE_CHAOS=%r is not an integer seed; ignored", v)
         return False
-    g_chaos.enable(seed)
+    try:
+        rate = float(os.environ.get("OSSE_CHAOS_RATE", "0.1"))
+    except ValueError:
+        rate = 0.1
+    g_chaos.enable(seed, rate=rate)
     return True

@@ -1,0 +1,21 @@
+# osselint: path=open_source_search_engine_tpu/parallel/fleet.py
+# osselint fixture — the fleet plane IS the sanctioned owner of child
+# processes and signals: the same shapes violations_proc.py flags must
+# produce zero findings here.
+import os
+import signal
+import subprocess
+import sys
+
+
+def spawn_node(argv):
+    return subprocess.Popen([sys.executable] + argv,
+                            start_new_session=True)
+
+
+def kill_node(pid):
+    os.kill(pid, signal.SIGKILL)
+
+
+def reap_group(pid):
+    os.killpg(pid, signal.SIGKILL)

@@ -1,0 +1,36 @@
+# osselint: path=open_source_search_engine_tpu/build/proc_fixture.py
+# osselint fixture — proc-spawn cases: child processes and signals
+# outside parallel/fleet.py and utils/chaos.py. Legal shapes (method
+# calls on a Popen handle someone owns, subprocess.run) ride along
+# unmarked to pin that the rule does NOT overreach.
+import os
+import subprocess
+from subprocess import Popen
+
+
+def spawn_raw(argv):
+    return subprocess.Popen(argv)  # EXPECT proc-spawn
+
+
+def spawn_imported(argv):
+    return Popen(argv)  # EXPECT proc-spawn
+
+
+def shoot(pid):
+    os.kill(pid, 9)  # EXPECT proc-spawn
+
+
+def shoot_group(pid):
+    os.killpg(pid, 9)  # EXPECT proc-spawn
+
+
+def split():
+    return os.fork()  # EXPECT proc-spawn
+
+
+def legal_shapes(argv, proc):
+    # a handle someone owns may be signalled; run() is synchronous and
+    # cannot leak an orphan past its own return
+    proc.kill()
+    proc.send_signal(15)
+    return subprocess.run(argv, check=False)

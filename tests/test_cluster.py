@@ -6,19 +6,18 @@ Reference behaviors pinned here: Msg1 write-to-all-twins with
 retry-forever (Msg1.cpp:20), Multicast serving-twin pick with reroute
 (Multicast.cpp:520), PingServer liveness (PingServer.h:61), and the
 faq.html:586 recovery story (a restarted twin serves again).
+
+The processes come from the fleet plane (`parallel.fleet.FleetManager`,
+supervise=False so THESE tests control death and rebirth by hand) —
+the osselint ``proc-spawn`` rule keeps raw Popen/os.kill out of here.
 """
 
 import json
-import os
-import signal
-import subprocess
-import sys
-import time
 import urllib.request
 
 import pytest
 
-REPO = str(__import__("pathlib").Path(__file__).resolve().parent.parent)
+from tests.polling import wait_until
 
 N_SHARDS = 2
 N_REPLICAS = 2
@@ -31,91 +30,31 @@ DOCS = {
 }
 
 
-def _wait_port(port: int, timeout: float = 60.0) -> None:
-    t0 = time.time()
-    while time.time() - t0 < timeout:
-        try:
-            with urllib.request.urlopen(
-                    f"http://127.0.0.1:{port}/rpc/ping", data=b"{}",
-                    timeout=1.0) as r:
-                if json.load(r).get("ok"):
-                    return
-        except Exception:
-            time.sleep(0.3)
-    raise TimeoutError(f"node on {port} never came up")
-
-
-class Nodes:
-    """Spawn/kill/restart the node processes of a loopback cluster."""
-
-    def __init__(self, tmp_path, ports):
-        self.tmp_path = tmp_path
-        self.ports = ports  # [shard][replica]
-        self.procs = {}
-
-    def dir_of(self, s, r):
-        return str(self.tmp_path / f"node_s{s}r{r}")
-
-    def start(self, s, r):
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "open_source_search_engine_tpu",
-             "node", "--dir", self.dir_of(s, r),
-             "--port", str(self.ports[s][r])],
-            env={"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
-                 "PATH": "/usr/bin:/bin", "HOME": str(self.tmp_path)},
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-        self.procs[(s, r)] = proc
-
-    def kill(self, s, r):
-        p = self.procs.pop((s, r))
-        p.send_signal(signal.SIGKILL)
-        p.wait()
-
-    def stop_all(self):
-        for p in self.procs.values():
-            p.kill()
-        for p in self.procs.values():
-            p.wait()
-
-
 @pytest.fixture
 def cluster(tmp_path):
-    import socket
+    from open_source_search_engine_tpu.parallel.cluster import ClusterClient
+    from open_source_search_engine_tpu.parallel.fleet import FleetManager
 
-    from open_source_search_engine_tpu.parallel.cluster import (
-        ClusterClient, HostsConf)
-
-    ports = []
-    socks = []
-    for s in range(N_SHARDS):
-        row = []
-        for r in range(N_REPLICAS):
-            sk = socket.socket()
-            sk.bind(("127.0.0.1", 0))
-            row.append(sk.getsockname()[1])
-            socks.append(sk)
-        ports.append(row)
-    for sk in socks:
-        sk.close()
-
-    nodes = Nodes(tmp_path, ports)
-    for s in range(N_SHARDS):
-        for r in range(N_REPLICAS):
-            nodes.start(s, r)
-    for s in range(N_SHARDS):
-        for r in range(N_REPLICAS):
-            _wait_port(ports[s][r])
-
-    conf = HostsConf.parse(
-        f"num-mirrors: {N_REPLICAS - 1}\n" + "\n".join(
-            f"127.0.0.1:{ports[s][r]}"
-            for r in range(N_REPLICAS) for s in range(N_SHARDS)))
-    client = ClusterClient(conf, use_heartbeat=False)
+    fm = FleetManager(tmp_path / "fleet", n_shards=N_SHARDS,
+                      n_replicas=N_REPLICAS, supervise=False)
     try:
-        yield nodes, client
+        fm.start_all()
+        client = ClusterClient(fm.conf, use_heartbeat=False)
+        try:
+            yield fm, client
+        finally:
+            client.close()
     finally:
-        client.close()
-        nodes.stop_all()
+        fm.shutdown()
+        assert fm.surviving_pids() == []
+
+
+def _kill(fm, s, r):
+    """SIGKILL a node and wait until the corpse is observable (so a
+    later start_node never races the not-yet-reaped pid)."""
+    fm.kill(s, r)
+    wait_until(lambda: not fm.alive(s, r), timeout=10.0,
+               desc=f"node s{s}r{r} dead after SIGKILL")
 
 
 def _search_urls(client, q, **kw):
@@ -126,7 +65,7 @@ def _search_urls(client, q, **kw):
 
 @pytest.mark.slow
 def test_cluster_end_to_end(cluster):
-    nodes, client = cluster
+    fm, client = cluster
 
     # --- writes fan out to all twins; search spans shards ---
     for url, html in DOCS.items():
@@ -138,7 +77,7 @@ def test_cluster_end_to_end(cluster):
     assert urls == set(DOCS)
 
     # --- kill ONE twin of shard 0: reroute serves everything ---
-    nodes.kill(0, 0)
+    _kill(fm, 0, 0)
     res, urls = _search_urls(client, "cluster words", topk=12)
     assert res.total_matches == len(DOCS)
     assert not res.degraded          # the twin covers the shard
@@ -156,18 +95,16 @@ def test_cluster_end_to_end(cluster):
     assert "http://s.test/late" in urls
 
     # --- kill the OTHER twin too: whole shard down → degraded ---
-    nodes.kill(0, 1)
+    _kill(fm, 0, 1)
     res, urls = _search_urls(client, "cluster words", topk=12)
     assert res.degraded
     assert 0 < len(urls) < len(DOCS)
 
     # --- restart one twin: its durable state + the retry queue catch
     # it up; the shard serves again ---
-    nodes.start(0, 0)
-    _wait_port(nodes.ports[0][0])
-    deadline = time.time() + 30
-    while client.pending_writes and time.time() < deadline:
-        time.sleep(0.5)
+    fm.start_node(0, 0, wait=True)
+    wait_until(lambda: client.pending_writes == 0, timeout=30.0,
+               interval=0.1, desc="retry queue drained into reborn twin")
     res, urls = _search_urls(client, "cluster words", topk=12)
     assert not res.degraded
     assert urls == set(DOCS)
@@ -182,12 +119,11 @@ def test_parm_broadcast_reaches_all_nodes_and_survives(cluster):
     update to EVERY node (all shards, all twins), a dead node catches
     up through the retry queue when it returns, and the value survives
     a node restart (persisted coll.conf)."""
-    nodes, client = cluster
-    import urllib.request
+    fm, client = cluster
 
     def parm_on(s, r, name):
         req = urllib.request.Request(
-            f"http://127.0.0.1:{nodes.ports[s][r]}/rpc/conf",
+            f"http://{fm.addr(s, r)}/rpc/conf",
             data=b"{}", method="POST")
         with urllib.request.urlopen(req, timeout=5.0) as resp:
             return json.load(resp)["conf"][name]
@@ -199,23 +135,22 @@ def test_parm_broadcast_reaches_all_nodes_and_survives(cluster):
             assert parm_on(s, r, "spider_delay_ms") == 4321, (s, r)
 
     # dead node: update parks in its ordered queue, applies on return
-    nodes.kill(0, 1)
+    _kill(fm, 0, 1)
     client.check_hosts()
     client.broadcast_parm("spider_delay_ms", 9999)
     assert parm_on(1, 0, "spider_delay_ms") == 9999
-    nodes.start(0, 1)
-    _wait_port(nodes.ports[0][1])
-    t0 = time.time()
-    while time.time() - t0 < 30:
+    fm.start_node(0, 1, wait=True)
+
+    def caught_up():
         client.check_hosts()
-        if client.pending_writes == 0 and \
-                parm_on(0, 1, "spider_delay_ms") == 9999:
-            break
-        time.sleep(0.5)
+        return (client.pending_writes == 0
+                and parm_on(0, 1, "spider_delay_ms") == 9999)
+
+    wait_until(caught_up, timeout=30.0, interval=0.1,
+               desc="parked parm applied on the reborn node")
     assert parm_on(0, 1, "spider_delay_ms") == 9999
 
     # restart a node with no pending queue: the persisted conf serves
-    nodes.kill(1, 0)
-    nodes.start(1, 0)
-    _wait_port(nodes.ports[1][0])
+    _kill(fm, 1, 0)
+    fm.start_node(1, 0, wait=True)
     assert parm_on(1, 0, "spider_delay_ms") == 9999

@@ -20,6 +20,7 @@ import numpy as np
 from open_source_search_engine_tpu.parallel import cluster as cl
 from open_source_search_engine_tpu.parallel import transport as tr
 from open_source_search_engine_tpu.utils.stats import g_stats
+from tests.polling import wait_until
 
 
 def _doc(i, words="cluster shared words"):
@@ -305,10 +306,9 @@ def test_hostqueue_ordered_redelivery_with_pooled_client(tmp_path):
         b = cl.ShardNodeServer(tmp_path / "back", port=port_b)
         b.start()
         try:
-            deadline = time.monotonic() + 30.0
-            while client.pending_writes and time.monotonic() < deadline:
-                time.sleep(0.2)
-            assert client.pending_writes == 0
+            wait_until(lambda: client.pending_writes == 0,
+                       timeout=30.0, interval=0.1,
+                       desc="parked writes drained into reborn twin")
             # ordered drain: the twin's final state is v2, not v1
             out = t.request(f"127.0.0.1:{port_b}", "/rpc/search",
                             {"q": "second edition", "topk": 5},

@@ -1,0 +1,148 @@
+"""Fleet plane tests — REAL OS node processes, fast enough for tier 1.
+
+Covers the FleetManager contract end to end across a true process
+boundary: spawn + readiness + seat identity, live parm broadcast
+(0x3f semantics: applied everywhere, no restart), SIGKILL + journal
+replay rejoin, drain-then-restart through the node admission gate,
+and the teardown-hygiene guarantee (zero surviving child pids, even
+when the test body raises).
+
+Every fixture teardown asserts ``surviving_pids() == []`` — the one
+invariant that keeps CI boxes free of orphaned node processes.
+"""
+
+import pytest
+
+from open_source_search_engine_tpu.parallel.fleet import FleetManager
+from tests.polling import wait_until
+
+DOC = ("<html><head><title>Fleet survivor</title></head><body>"
+       "<p>fleet durability words ftoken{i}.</p></body></html>")
+
+
+def _index(fm, addr, i):
+    out = fm.transport.request(
+        addr, "/rpc/index",
+        {"url": f"http://fleet.test/{i}", "content": DOC.format(i=i)},
+        timeout=60.0)
+    assert out["ok"], out
+    return out
+
+
+@pytest.fixture
+def fleet(tmp_path):
+    """One shard, two twins, no supervisor — the tests decide who dies
+    and who comes back."""
+    fm = FleetManager(tmp_path / "fleet", n_shards=1, n_replicas=2,
+                      chaos_seed=5, supervise=False)
+    try:
+        fm.start_all()
+        yield fm
+    finally:
+        fm.shutdown()
+        assert fm.surviving_pids() == []
+
+
+def test_spawn_readiness_and_identity(fleet):
+    fm = fleet
+    pids = set()
+    for r in range(fm.n_replicas):
+        ping = fm.wait_ready(0, r)
+        assert ping["ok"] and ping["docs"] == 0
+        assert (ping["shard"], ping["replica"]) == (0, r)
+        assert ping["draining"] is False
+        pids.add(ping["pid"])
+    assert len(pids) == fm.n_replicas  # distinct real processes
+    # children are spawned with the chaos seed (seams armed, ambient
+    # rate 0) and the serialized cluster map
+    env = fm._child_env()
+    assert env["OSSE_CHAOS"] == "5"
+    assert env["OSSE_CHAOS_RATE"] == "0"
+    assert fm.hosts_path.read_text()  # hosts.conf handed to every node
+
+
+def test_parm_broadcast_applies_on_every_node_without_restart(fleet):
+    fm = fleet
+    pids_before = dict(fm.pids())
+    replies = fm.broadcast_parms({"spider_delay_ms": 2718})
+    assert len(replies) == fm.n_shards * fm.n_replicas
+    for addr, r in replies.items():
+        assert r is not None and r["ok"], (addr, r)
+        assert "spider_delay_ms" in r["applied"]
+        assert r["pid"] == pids_before[
+            next(sr for sr in fm.pids()
+                 if fm.addr(*sr) == addr)]
+    for s in range(fm.n_shards):
+        for r in range(fm.n_replicas):
+            conf = fm.transport.request(fm.addr(s, r), "/rpc/conf",
+                                        {}, timeout=10.0)
+            assert conf["conf"]["spider_delay_ms"] == 2718
+    assert dict(fm.pids()) == pids_before  # applied live, no restart
+
+
+def test_sigkill_journal_replay_rejoin(fleet):
+    fm = fleet
+    for i in range(3):  # write to BOTH twins (the client's fan-out)
+        _index(fm, fm.addr(0, 0), i)
+        _index(fm, fm.addr(0, 1), i)
+    # kill -9 replica 0: no save, no atexit — journals only
+    fm.kill(0, 0)
+    wait_until(lambda: not fm.alive(0, 0), timeout=10.0,
+               desc="node dead after SIGKILL")
+    fm.start_node(0, 0, wait=True)
+    ping0 = fm.wait_ready(0, 0)
+    ping1 = fm.wait_ready(0, 1)
+    assert ping0["docs"] == ping1["docs"] == 3  # replay conserved all
+    out = fm.transport.request(fm.addr(0, 0), "/rpc/search",
+                               {"q": "fleet durability", "topk": 5},
+                               timeout=60.0)
+    assert out["ok"] and out["total"] == 3
+    stats = fm.transport.request(fm.addr(0, 0), "/rpc/stats", {},
+                                 timeout=10.0)
+    assert stats["ok"] and "stats" in stats
+
+
+def test_drain_then_restart_through_admission_gate(fleet):
+    fm = fleet
+    _index(fm, fm.addr(0, 0), 7)
+    out = fm.transport.request(fm.addr(0, 0), "/rpc/drain",
+                               {"timeout_s": 5.0}, timeout=10.0)
+    assert out["ok"] and out["drained"], out
+    ping = fm.transport.request(fm.addr(0, 0), "/rpc/ping", {},
+                                timeout=10.0)
+    assert ping["draining"] is True
+    # the gate is closed: data-plane RPCs shed instead of admitting
+    shed = fm.transport.request(fm.addr(0, 0), "/rpc/search",
+                                {"q": "fleet", "topk": 5},
+                                timeout=10.0)
+    assert shed["ok"] is False and shed["shed"] == "draining"
+    # orderly stop (SIGTERM → save) and rebirth on the same dir
+    assert fm.stop_node(0, 0) is not None
+    fm.start_node(0, 0, wait=True)
+    ping = fm.wait_ready(0, 0)
+    assert ping["draining"] is False  # fresh gate
+    assert ping["docs"] == 1          # checkpointed state intact
+
+
+def test_teardown_reaps_even_when_the_body_raises(tmp_path):
+    fm = FleetManager(tmp_path / "f2", n_shards=1, n_replicas=1,
+                      supervise=False)
+    with pytest.raises(RuntimeError, match="boom"):
+        with fm:
+            assert fm.alive(0, 0)
+            raise RuntimeError("boom")
+    assert fm.surviving_pids() == []
+
+
+def test_atexit_reaper_kills_the_process_group(tmp_path):
+    """The last-resort finalizer: simulate an owner that never reaches
+    shutdown() — _atexit_reap() alone must leave no survivors."""
+    fm = FleetManager(tmp_path / "f3", n_shards=1, n_replicas=1,
+                      supervise=False)
+    fm.start_all()
+    assert fm.surviving_pids()
+    fm._atexit_reap()
+    wait_until(lambda: fm.surviving_pids() == [], timeout=10.0,
+               desc="atexit reaper cleared every child")
+    fm.shutdown()  # idempotent
+    assert fm.surviving_pids() == []

@@ -132,8 +132,12 @@ class TestKillMinus9ZeroLoss:
             "print('UP', s.port, flush=True); time.sleep(600)"
             % (REPO, node_dir))
         env = dict(os.environ, JAX_PLATFORMS="cpu")
-        proc = subprocess.Popen([sys.executable, "-c", code], env=env,
-                                stdout=subprocess.PIPE)
+        # the serve-layer child shape predates the fleet plane and pins
+        # the single-server durability story; the node-level twin of
+        # this scenario (below) rides FleetManager
+        proc = subprocess.Popen(  # osselint: ignore[proc-spawn] — legacy serve-layer child, see comment above
+            [sys.executable, "-c", code], env=env,
+            stdout=subprocess.PIPE)
         try:
             line = proc.stdout.readline().decode()  # blocks until UP
             assert line.startswith("UP "), \
@@ -173,3 +177,49 @@ class TestKillMinus9ZeroLoss:
         assert res.results[0].url == "http://kill.test/doc1"
         rec = get_document(coll, url="http://kill.test/doc1")
         assert rec is not None and rec["title"] == "Survivor page"
+
+
+class TestKillMinus9NodeProcess:
+    """The same contract one level up: a REAL ``node`` process (fleet
+    plane spawn) SIGKILLed mid-inject restarts from its checkpoint dir,
+    replays BOTH journal layers, and serves every acked write —
+    ``/rpc/stats`` answers clean afterwards."""
+
+    def test_node_kill9_journal_replay(self, tmp_path):
+        from open_source_search_engine_tpu.parallel.fleet import \
+            FleetManager
+
+        docs = {
+            f"http://kill.test/n{i}": (
+                f"<html><head><title>Node survivor {i}</title></head>"
+                f"<body><p>node durability words survive kill nine "
+                f"ntoken{i}.</p></body></html>")
+            for i in range(4)
+        }
+        with FleetManager(tmp_path / "fleet", n_shards=1, n_replicas=1,
+                          supervise=False) as fm:
+            addr = fm.addr(0, 0)
+            for url, html in docs.items():
+                out = fm.transport.request(
+                    addr, "/rpc/index",
+                    {"url": url, "content": html}, timeout=60.0)
+                assert out["ok"], out          # the ACK
+            # kill -9: no save(), no atexit — only the journals remain
+            fm.kill(0, 0)
+            from tests.polling import wait_until
+            wait_until(lambda: not fm.alive(0, 0), timeout=10.0,
+                       desc="node dead after SIGKILL")
+
+            # restart on the same checkpoint dir; replay must restore
+            # every acked write (count AND content)
+            fm.start_node(0, 0, wait=True)
+            ping = fm.wait_ready(0, 0)
+            assert ping["docs"] == len(docs), ping
+            out = fm.transport.request(
+                addr, "/rpc/search",
+                {"q": "node durability", "topk": 10}, timeout=60.0)
+            assert out["ok"] and out["total"] == len(docs), out
+            stats = fm.transport.request(addr, "/rpc/stats", {},
+                                         timeout=10.0)
+            assert stats["ok"] and "stats" in stats
+        assert fm.surviving_pids() == []

@@ -174,6 +174,37 @@ class TestSeededRegressions:
             mono, "open_source_search_engine_tpu/parallel/cluster.py") \
             == []
 
+    def test_proc_spawn_outside_fleet_plane_is_caught(self):
+        # the literal pre-fleet shape: tests/test_cluster.py Popen'd
+        # node processes by hand and killed them with raw os.kill —
+        # orphans survived any test body that raised
+        src = ("import os\n"
+               "import subprocess\n"
+               "def boot(argv, pid):\n"
+               "    p = subprocess.Popen(argv)\n"
+               "    os.kill(pid, 9)\n"
+               "    return p\n")
+        found = osselint.check_source(
+            src, "open_source_search_engine_tpu/parallel/cluster.py")
+        assert [f.rule for f in found] == ["proc-spawn", "proc-spawn"]
+        found = osselint.check_source(src, "tests/test_cluster.py")
+        assert [f.rule for f in found] == ["proc-spawn", "proc-spawn"]
+        # the fleet and chaos planes ARE the sanctioned owners...
+        assert osselint.check_source(
+            src, "open_source_search_engine_tpu/parallel/fleet.py") \
+            == []
+        assert osselint.check_source(
+            src, "open_source_search_engine_tpu/utils/chaos.py") == []
+        # ...and tools/ scripts run outside the serving tree
+        assert osselint.check_source(src, "tools/opsctl.py") == []
+        # method calls on an owned handle stay legal everywhere
+        legal = ("def stop(proc):\n"
+                 "    proc.kill()\n"
+                 "    proc.send_signal(15)\n")
+        assert osselint.check_source(
+            legal,
+            "open_source_search_engine_tpu/parallel/cluster.py") == []
+
 
 class TestJitSeededRegressions:
     """The literal jit hazard shapes the PR 7 rules caught (or

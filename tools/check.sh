@@ -49,4 +49,13 @@ BENCH_SLO=1 JAX_PLATFORMS=cpu python bench.py
 BENCH_LOAD=1 BENCH_LOAD_QPS=6,12 BENCH_LOAD_SECONDS=2 \
     BENCH_LOAD_P99_MS=2000 BENCH_LOAD_OVER_P99_MS=3000 \
     JAX_PLATFORMS=cpu python bench.py
+
+# 6. fleet smoke: 2×2 REAL node processes under open-loop load —
+#    wedge→SIGKILL a primary with the twin absorbing every query
+#    (hedge fired+won, zero lost), journal-replay rejoin, rolling
+#    restart through the admission gate, live parm broadcast, and the
+#    2→3 cross-process shard split; exits nonzero unless every gate
+#    holds and no child process survives teardown
+BENCH_FLEET=1 BENCH_FLEET_SECONDS=5 BENCH_FLEET_QPS=8 \
+    JAX_PLATFORMS=cpu python bench.py
 echo "check.sh: OK"

@@ -29,6 +29,11 @@ reference-engine discipline that keeps it from shipping one):
 * ``device-sync`` — ``jax.device_get``/``block_until_ready`` force a
   host sync; outside the two blessed device-boundary modules they
   silently serialize the TPU pipeline.
+* ``proc-spawn`` — child processes and signals are the fleet plane's
+  job: ``subprocess.Popen`` / ``os.kill`` / ``os.fork`` outside
+  ``parallel/fleet.py`` and ``utils/chaos.py`` spawn or kill processes
+  no supervisor tracks and no teardown reaps — exactly the orphan
+  leaks the FleetManager process groups exist to prevent.
 
 The ``jit-*`` family covers JAX trace discipline — the failure modes
 are invisible until they show up as a latency cliff (the Gigablast
@@ -399,6 +404,45 @@ def rule_thread_spawn(ctx: Ctx) -> list[Finding]:
 
 def _thread_scope(rel: str) -> bool:
     return _in_pkg(rel) and rel != f"{PKG}/utils/threads.py"
+
+
+#: signal/fork primitives that create or destroy processes behind the
+#: fleet plane's back (``proc.kill()``/``send_signal()`` methods on a
+#: Popen handle stay legal — they act on a handle someone owns)
+_PROC_CALLS = {"os.kill", "os.killpg", "os.fork", "os.forkpty"}
+
+
+def rule_proc_spawn(ctx: Ctx) -> list[Finding]:
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        if not name:
+            continue
+        if name == "Popen" or name.endswith(".Popen"):
+            what = "subprocess.Popen"
+        elif name in _PROC_CALLS:
+            what = name
+        else:
+            continue
+        out.append(Finding(
+            ctx.rel, node.lineno, "proc-spawn",
+            f"{what} outside the fleet plane — child processes and "
+            "signals belong to parallel/fleet.py (supervised, "
+            "process-grouped, reaped at teardown) or utils/chaos.py "
+            "(aimed faults); a stray spawn/kill leaks orphans no "
+            "teardown reaps"))
+    return out
+
+
+def _proc_scope(rel: str) -> bool:
+    """Package + tests, minus the two modules whose job this is.
+    tools/ is out of scope by construction — build/ops scripts run
+    outside the serving tree."""
+    if rel in (f"{PKG}/parallel/fleet.py", f"{PKG}/utils/chaos.py"):
+        return False
+    return rel.startswith((f"{PKG}/", "tests/"))
 
 
 def _module_mutables(tree: ast.Module) -> set[str]:
@@ -1070,6 +1114,7 @@ RULES = [
     ("bare-deadline", _timed_scope, rule_bare_deadline),
     ("adhoc-timing", _timed_scope, rule_adhoc_timing),
     ("admission-bypass", _admission_scope, rule_admission_bypass),
+    ("proc-spawn", _proc_scope, rule_proc_spawn),
 ]
 
 RULE_NAMES = {name for name, _p, _c in RULES}
