@@ -175,58 +175,243 @@ def _make_queries(n: int, seed: int):
     return out
 
 
-def main_mesh(n_shards: int) -> None:
-    """Multi-chip mode (BENCH_MESH=N): the resident kernel sharded over
-    an N-device mesh — one DeviceIndex per shard pinned per device,
-    cluster-wide term stats, Msg3a merge. With one physical TPU on this
-    machine it runs on N virtual CPU devices: a CORRECTNESS/SCALING
-    exercise of the production multi-chip path, not a TPU perf number
-    (the JSON line says so)."""
-    import os as _os
-    flags = _os.environ.get("XLA_FLAGS", "")
-    if "host_platform_device_count" not in flags:
-        _os.environ["XLA_FLAGS"] = (
-            f"{flags} --xla_force_host_platform_device_count={n_shards}")
-    import jax
-    try:
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
-    try:
-        jax = _init_backend()
-    except Exception as e:  # noqa: BLE001
-        _emit_stale_curve(f"backend init failed after retries: {e}")
-        return
-
-    from open_source_search_engine_tpu.parallel.sharded import (
-        MeshResident, ShardedCollection)
-
-    bdir = os.environ.get("BENCH_DIR") or tempfile.mkdtemp(
-        prefix="osse_bench_mesh_")
-    n_docs = int(os.environ.get("BENCH_DOCS", "5000"))
-    sc = ShardedCollection("bench", bdir, n_shards=n_shards)
+def _mesh_build_sc(bdir: str, n_shards: int, n_docs: int,
+                   n_replicas: int = 1):
+    """Build (or reuse) a sharded bench corpus through the real
+    indexing pipeline, dumped so queries serve from the on-disk base."""
+    from open_source_search_engine_tpu.parallel.sharded import \
+        ShardedCollection
+    sc = ShardedCollection("bench", bdir, n_shards=n_shards,
+                           n_replicas=n_replicas)
+    for row in sc.grid:
+        for c in row:
+            c.conf.pqr_enabled = False
     if sc.num_docs < n_docs:
         for url, html in _gen_docs(n_docs):
             sc.index_document(url, html)
-        for shard in sc.shards:
-            shard.posdb.dump()
-            shard.titledb.dump()
-            shard.save()
-    mr = MeshResident(sc)
-    qs = _make_queries(96, seed=7)
-    for q in qs[:16]:
-        mr.search(q, topk=10, with_snippets=False)  # compile warm
+        for row in sc.grid:
+            for shard in row:
+                shard.posdb.dump()
+                shard.titledb.dump()
+                shard.save()
+    return sc
+
+
+def _mesh_jit_leg(mr) -> dict:
+    """The trace-discipline leg of the mesh gate: 64 steady-state mesh
+    waves with VARYING (bucketed) batch sizes under the jit watcher —
+    zero compiles, zero retraces, and the only transfers on the wave
+    boundary (the device_put at issue + the one device_get at collect,
+    both in parallel/sharded.py, a jitwatch BOUNDARY_SITE). This is
+    the machine proof that nothing crosses the host between shard
+    intersection and merged top-k."""
+    from open_source_search_engine_tpu.query import engine
+    from open_source_search_engine_tpu.utils import jitwatch
+    msi = mr._serve_index()
+    plans = [engine._compile_cached(q, 0)
+             for q in _make_queries(16, seed=11)]
+    jitwatch.enable()
+    # warm every live batch bucket once (compiles excluded from gate)
+    for b in (3, 8, 16):
+        msi.collect_batch(msi.issue_batch(plans[:b], topk=10))
+    jitwatch.reset()
+    n_waves = int(os.environ.get("BENCH_MESH_JIT_WAVES", "64"))
+    # deterministic varying sizes: buckets 4/8/16 revisited, never new
+    sizes = [16, 5, 9, 16, 3, 12, 8, 16]
     t0 = time.perf_counter()
-    for a in range(16, len(qs), 16):
-        mr.search_batch(qs[a:a + 16], topk=10, with_snippets=False)
-    elapsed = time.perf_counter() - t0
-    qps = (len(qs) - 16) / elapsed
-    print(json.dumps({
+    for k in range(n_waves):
+        b = sizes[k % len(sizes)]
+        msi.collect_batch(msi.issue_batch(plans[:b], topk=10))
+    dt = time.perf_counter() - t0
+    snap = jitwatch.snapshot()
+    jitwatch.disable()
+    t = snap["totals"]
+    offb = [e["site"] for e in snap["events"]
+            if e["kind"] == "transfer" and not e["boundary"]]
+    return {"waves": n_waves,
+            "wave_ms": round(1000 * dt / n_waves, 2),
+            "compiles": t["compiles"], "retraces": t["retraces"],
+            "transfers_offboundary": t["transfers_offboundary"],
+            "offboundary_sites": offb,
+            "ok": (t["compiles"] == 0 and t["retraces"] == 0
+                   and t["transfers_offboundary"] == 0)}
+
+
+def _mesh_child() -> None:
+    """One curve point, run in a subprocess so XLA_FLAGS can force its
+    own host device count before jax imports. Config rides the
+    BENCH_MESH_CHILD env as JSON; emits one JSON line on stdout."""
+    cfg = json.loads(os.environ["BENCH_MESH_CHILD"])
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    mode, S = cfg["mode"], int(cfg["shards"])
+    n_docs = int(cfg["docs"])
+    nq = int(cfg.get("queries", 96))
+    batch = int(cfg.get("batch", 16))
+    bdir = cfg.get("dir") or tempfile.mkdtemp(prefix="osse_mesh_")
+    rep: dict = {"mode": mode, "shards": S, "docs": n_docs}
+
+    if mode == "failover":
+        # chaos leg: kill one mesh shard's serving twin mid-serving —
+        # the next wave packs from the survivor (drain-before-refresh),
+        # same answers, zero lost queries
+        from open_source_search_engine_tpu.parallel.sharded import \
+            MeshResident
+        sc = _mesh_build_sc(bdir, S, n_docs, n_replicas=2)
+        mr = MeshResident(sc)
+        qs = _make_queries(8, seed=7)
+        key = lambda res: [(r.docid, round(r.score, 3))
+                           for r in res.results]
+        lost = 0
+        try:
+            base = [mr.serve(q, topk=10, with_snippets=False)
+                    for q in qs]
+            sc.hostmap.mark_dead(0, 0)
+            after = []
+            for q in qs:
+                try:
+                    after.append(mr.serve(q, topk=10,
+                                          with_snippets=False))
+                except Exception:  # noqa: BLE001 — a lost query
+                    lost += 1
+            parity = (len(after) == len(base)
+                      and all(key(a) == key(b) and not a.degraded
+                              for a, b in zip(after, base)))
+            rep.update({"lost": lost, "parity": parity,
+                        "ok": lost == 0 and parity})
+        finally:
+            mr.stop()
+        print(json.dumps(rep))
+        return
+
+    qs = _make_queries(nq + batch, seed=7)
+    if mode == "ref":
+        # the single-chip production path holding the SAME corpus the
+        # gate's mesh point shards over — the strong-scaling baseline
+        from open_source_search_engine_tpu.build import docproc
+        from open_source_search_engine_tpu.index.collection import \
+            Collection
+        from open_source_search_engine_tpu.query import engine
+        coll = Collection("bench", bdir)
+        coll.conf.pqr_enabled = False
+        if coll.num_docs < n_docs:
+            docproc.index_batch(coll, list(_gen_docs(n_docs)))
+            coll.posdb.dump()
+            coll.titledb.dump()
+            coll.save()
+        run = lambda b: engine.search_device_batch(
+            coll, b, topk=10, with_snippets=False)
+    else:
+        from open_source_search_engine_tpu.parallel.sharded import \
+            MeshResident
+        sc = _mesh_build_sc(bdir, S, n_docs)
+        mr = MeshResident(sc)
+        run = lambda b: mr.serve_batch(b, topk=10, with_snippets=False)
+
+    run(qs[:batch])  # compile warm
+    t0 = time.perf_counter()
+    for a in range(batch, len(qs), batch):
+        run(qs[a:a + batch])
+    qps = (len(qs) - batch) / (time.perf_counter() - t0)
+    rep.update({"qps": round(qps, 2), **_backend_record()})
+    if mode == "mesh" and cfg.get("jit"):
+        rep["jit"] = _mesh_jit_leg(mr)
+    if mode == "mesh":
+        mr.stop()
+    print(json.dumps(rep))
+
+
+def main_mesh() -> dict:
+    """Mesh serving gate (BENCH_MESH=1): the scale curve of the
+    mesh-RESIDENT serving path — qps vs shard count at FIXED docs per
+    shard, each point a subprocess forcing that many host devices
+    (``--xla_force_host_platform_device_count``), so the multi-chip
+    program runs exactly as on a slice, minus the ICI.
+
+    Gates (exit 1 on violation):
+    * the in-jit merge at 4 shards sustains ≥ BENCH_MESH_MIN_SPEEDUP
+      (default 1.5×) the qps of the single-chip production path
+      holding the SAME corpus — the Msg3a-on-device headline;
+    * jitwatch attributes ZERO compiles/retraces/off-boundary
+      transfers to 64 steady-state mesh waves of varying (bucketed)
+      batch sizes — only the wave-boundary device_put/device_get
+      touch the host between shard intersection and merged top-k;
+    * killing one mesh shard's serving twin mid-serving loses zero
+      queries and degrades to the twin with identical answers.
+
+    CPU-device numbers validate SCALING SHAPE and the host-hop
+    deletion, not absolute TPU qps (the JSON says which backend
+    measured them)."""
+    import subprocess
+
+    shards = [int(s) for s in os.environ.get(
+        "BENCH_MESH_SHARDS", "1,2,4,8").split(",")]
+    dps = int(os.environ.get("BENCH_MESH_DPS", "400"))
+    nq = int(os.environ.get("BENCH_MESH_QUERIES", "96"))
+    min_speedup = float(os.environ.get("BENCH_MESH_MIN_SPEEDUP", "1.5"))
+    gate_s = 4 if 4 in shards else max(shards)
+    bdir = os.environ.get("BENCH_DIR")
+
+    def child(cfg: dict, devices: int) -> dict:
+        if bdir:
+            cfg["dir"] = os.path.join(
+                bdir, f"{cfg['mode']}{cfg['shards']}x{cfg['docs']}")
+        env = dict(os.environ)
+        env["BENCH_MESH_CHILD"] = json.dumps(cfg)
+        env["XLA_FLAGS"] = (f"{env.get('XLA_FLAGS', '')} "
+                            f"--xla_force_host_platform_device_count="
+                            f"{max(devices, 1)}")
+        p = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           env=env, capture_output=True, text=True,
+                           timeout=3600)
+        sys.stderr.write(p.stderr[-2000:])
+        for line in reversed(p.stdout.strip().splitlines()):
+            try:
+                rec = json.loads(line)
+                if rec.get("mode") == cfg["mode"]:
+                    return rec
+            except ValueError:
+                continue
+        return {"mode": cfg["mode"], "error":
+                f"child rc={p.returncode}: {p.stdout[-300:]}"}
+
+    curve = [child({"mode": "mesh", "shards": s, "docs": s * dps,
+                    "queries": nq, "jit": s == gate_s}, devices=s)
+             for s in shards]
+    ref = child({"mode": "ref", "shards": 1, "docs": gate_s * dps,
+                 "queries": nq}, devices=1)
+    failover = child({"mode": "failover", "shards": 2,
+                      "docs": int(os.environ.get(
+                          "BENCH_MESH_FAILOVER_DOCS", "120"))},
+                     devices=2)
+
+    gate_pt = next((p for p in curve if p.get("shards") == gate_s), {})
+    qps_mesh = gate_pt.get("qps") or 0.0
+    qps_ref = ref.get("qps") or 0.0
+    speedup = qps_mesh / qps_ref if qps_ref else 0.0
+    jit = gate_pt.get("jit", {})
+    gates = {
+        f"speedup_{gate_s}_shards_ge_{min_speedup}x":
+            speedup >= min_speedup,
+        "jit_zero_compiles_retraces_offboundary":
+            bool(jit.get("ok")),
+        "failover_zero_lost_identical":
+            bool(failover.get("ok")),
+    }
+    ok = all(gates.values())
+    rep = {
+        "metric": "mesh_serve_speedup_vs_single_chip",
+        "value": round(speedup, 2), "unit": "x",
+        "ok": ok, "gates": gates,
+        "gate_shards": gate_s, "docs_per_shard": dps,
+        "qps_mesh": qps_mesh, "qps_single_chip_same_corpus": qps_ref,
+        "scale_curve": [{k: p.get(k) for k in
+                         ("shards", "docs", "qps", "error")}
+                        for p in curve],
+        "jit": jit, "failover": failover,
         **_backend_record(),
-        "metric": "queries_per_sec_mesh_cpu_validation",
-        "value": round(qps, 2), "unit": "qps",
-        "vs_baseline": 0.0, "n_shards": n_shards, "docs": n_docs,
-    }))
+    }
+    print(json.dumps(rep))
+    return rep
 
 
 def main_transport() -> None:
@@ -637,6 +822,39 @@ def main_jit() -> None:
     ok = (t["compiles"] == 0 and t["retraces"] == 0
           and t["transfers_offboundary"] == 0)
     lats.sort()
+
+    # the same discipline for the MESH program: a subprocess (it must
+    # force 4 host devices before jax imports) runs 64 varying-batch
+    # steady-state mesh waves under the watcher — transfers only at
+    # the wave's issue/collect boundary
+    mesh_jit: dict = {}
+    if os.environ.get("BENCH_JIT_MESH", "1") != "0":
+        import subprocess
+        cfg = {"mode": "mesh", "shards": 4,
+               "docs": 4 * int(os.environ.get("BENCH_JIT_MESH_DPS",
+                                              "60")),
+               "queries": 16, "jit": True}
+        env = dict(os.environ)
+        env["BENCH_MESH_CHILD"] = json.dumps(cfg)
+        env["XLA_FLAGS"] = (f"{env.get('XLA_FLAGS', '')} "
+                            "--xla_force_host_platform_device_count=4")
+        p = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           env=env, capture_output=True, text=True,
+                           timeout=1800)
+        for line in reversed(p.stdout.strip().splitlines()):
+            try:
+                rec = json.loads(line)
+                if rec.get("mode") == "mesh":
+                    mesh_jit = rec.get("jit", {})
+                    break
+            except ValueError:
+                continue
+        if not mesh_jit:
+            mesh_jit = {"ok": False, "error":
+                        f"mesh child rc={p.returncode}: "
+                        f"{p.stdout[-300:]}"}
+        ok = ok and bool(mesh_jit.get("ok"))
+
     print(json.dumps({
         **_backend_record(),
         "metric": "jit_steady_state_compiles",
@@ -648,8 +866,10 @@ def main_jit() -> None:
         "transfers_offboundary": t["transfers_offboundary"],
         "offboundary_sites": [e["site"] for e in offb],
         "attribution": snap["events"],
+        "mesh": mesh_jit,
         "ok": ok,
-        "budget": "zero compiles/retraces/off-boundary transfers",
+        "budget": "zero compiles/retraces/off-boundary transfers "
+                  "(flat resident waves AND mesh waves)",
     }))
     if not ok:
         sys.exit(1)
@@ -1792,8 +2012,10 @@ def main_fleet() -> dict:
 if __name__ == "__main__":
     if os.environ.get("BENCH_SOAK"):
         sys.exit(0 if main_soak()["ok"] else 1)
+    elif os.environ.get("BENCH_MESH_CHILD"):
+        _mesh_child()
     elif os.environ.get("BENCH_MESH"):
-        main_mesh(int(os.environ["BENCH_MESH"]))
+        sys.exit(0 if main_mesh()["ok"] else 1)
     elif os.environ.get("BENCH_TRANSPORT"):
         main_transport()
     elif os.environ.get("BENCH_CACHE"):

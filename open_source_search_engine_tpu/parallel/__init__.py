@@ -12,7 +12,9 @@ processes, twin failover, retry-forever writes) → :mod:`cluster`.
 
 from .cluster import ClusterClient, HostsConf, ShardNodeServer
 from .hostmap import HostMap, make_mesh
-from .sharded import ShardedCollection, sharded_search
+from .sharded import (MeshResident, MeshServeIndex, ShardedCollection,
+                      sharded_search)
 
-__all__ = ["ClusterClient", "HostMap", "HostsConf", "ShardNodeServer",
-           "ShardedCollection", "make_mesh", "sharded_search"]
+__all__ = ["ClusterClient", "HostMap", "HostsConf", "MeshResident",
+           "MeshServeIndex", "ShardNodeServer", "ShardedCollection",
+           "make_mesh", "sharded_search"]

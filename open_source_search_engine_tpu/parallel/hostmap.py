@@ -111,6 +111,16 @@ class HostMap:
                 return r
         return None
 
+    def serving_vector(self) -> tuple:
+        """Read-side topology snapshot: the serving replica per shard
+        (None where a whole shard is down). Part of the mesh serving
+        GENERATION — when a twin dies (``mark_dead``) this tuple moves,
+        the ResidentLoop drains in-flight waves against their
+        issue-time bases, and the next wave packs from the surviving
+        twin; a kill therefore loses zero queries."""
+        return tuple(self.serving_replica(s)
+                     for s in range(self.n_shards))
+
     def hosts_up(self) -> int:
         """Live host count across the whole grid (the fleet scrape's
         ``cluster.scrape_hosts_up`` gauge, from this map's view)."""

@@ -40,12 +40,12 @@ from ..index.collection import Collection
 from ..index.tagdb import Tagdb
 from ..query import weights
 from ..query.compiler import QueryPlan, compile_query
-from ..query.engine import SearchResults, build_results
+from ..query.engine import MAX_PER_SITE, SearchResults, build_results
 from ..query.packer import (MAX_POSITIONS, PackedQuery, PreparedQuery,
                             pad_table,
                             _bucket, _pad1, group_flags, pack_pass,
                             prepare_query)
-from ..query.scorer import score_core
+from ..query.scorer import merge_dedup_topk, score_core
 from ..utils.log import get_logger
 from ..utils.membudget import g_membudget
 from .hostmap import SHARD_AXIS, HostMap, make_mesh
@@ -653,6 +653,415 @@ def sharded_search(sc: ShardedCollection, q: str | QueryPlan, *,
         suggestion=suggest_sharded(sc, plan) if total == 0 else None)
 
 
+# ---------------------------------------------------------------------------
+# mesh-resident serving: the Msg3a merge ON the device (one program/wave)
+# ---------------------------------------------------------------------------
+
+def _site_cols(coll: Collection):
+    """One shard's clusterdb lookup columns (sorted docids + aligned
+    sitehash/langid), cached on the clusterdb Rdb version — pack-time
+    candidate sitehash columns become one vectorized searchsorted
+    instead of D point reads per query."""
+    ver = coll.clusterdb.version
+    cached = getattr(coll, "_mesh_site_cols", None)
+    if cached is not None and cached[0] == ver:
+        return cached[1]
+    from ..index import clusterdb as cdb
+    lst = coll.clusterdb.get_all()
+    if len(lst):
+        f = cdb.unpack_key(lst.keys)
+        order = np.argsort(f["docid"], kind="stable")
+        cols = (f["docid"][order].astype(np.uint64),
+                f["sitehash"][order].astype(np.uint32),
+                f["langid"][order].astype(np.uint32))
+    else:
+        cols = (np.empty(0, np.uint64), np.empty(0, np.uint32),
+                np.empty(0, np.uint32))
+    coll._mesh_site_cols = (ver, cols)
+    return cols
+
+
+def _cand_site_cols(coll: Collection, cand: np.ndarray):
+    """Candidate docids → (sitehash, langid) uint32 columns. Duplicate
+    clusterdb records per docid keep the LATEST (side='right' − 1, the
+    same last-wins rule as ``_coll_langid_of``); missing records map to
+    0 — exempt from site clustering, like the host walk."""
+    docids, sh, lg = _site_cols(coll)
+    out_sh = np.zeros(len(cand), np.uint32)
+    out_lg = np.zeros(len(cand), np.uint32)
+    if len(docids) and len(cand):
+        pos = np.searchsorted(docids, cand, side="right") - 1
+        ok = pos >= 0
+        ok[ok] = docids[pos[ok]] == cand[ok]
+        out_sh[ok] = sh[pos[ok]]
+        out_lg[ok] = lg[pos[ok]]
+    return out_sh, out_lg
+
+
+def mesh_generation(sc: ShardedCollection) -> tuple:
+    """The mesh serving generation: corpus mutations × read topology ×
+    per-serving-twin posdb versions. Any write, twin death (mark_dead)
+    or recovery moves this tuple; the ResidentLoop's freshness protocol
+    then drains in-flight waves against their issue-time base and packs
+    the next wave from the NEW serving twins — which is exactly the
+    twin-failover story: a dead chip's shard degrades to its twin's
+    base with zero lost queries."""
+    serving = sc.hostmap.serving_vector()
+    return (sc.mutations, serving,
+            tuple(sc.grid[s][r].posdb.version if r is not None else -1
+                  for s, r in enumerate(serving)))
+
+
+@partial(jax.jit, static_argnames=("mesh", "local_k", "out_k",
+                                   "n_positions", "use_filter",
+                                   "use_sort"))
+def _mesh_serve(mesh, doc_idx, payload, slot, valid, freq_weight,
+                required, negative, scored, counts, table, siterank,
+                doclang, qlang, n_docs, filt, sortc, dochi, doclo,
+                shash, n_cand, local_k: int, out_k: int,
+                n_positions: int = MAX_POSITIONS,
+                use_filter: bool = False, use_sort: bool = False):
+    """The mesh-resident serving program: one ``shard_map`` per ticket
+    wave doing per-shard intersection + scoring (vmapped over the query
+    batch), the in-jit all-gather top-k merge, AND the clusterdb
+    2-per-site dedup as over-fetch k·c — no host hop anywhere between
+    shard search and merged, deduped top-k.
+
+    Inputs carry [S, B, ...]; ``dochi``/``doclo`` are the split uint32
+    halves of each shard's candidate docids and ``shash`` the per-
+    candidate sitehash ([S, B, D]), so the merge output needs no host
+    (shard, local)→docid resolution. ``n_cand`` [S, B] masks pad rows.
+    Output is replicated uint32 [B, 3 + 5·out_k]: per query
+    ``[total, n_kept, n_dropped, hi…, lo…, sitehash…, bitcast(score)…,
+    cumdrop…]`` with survivors compacted to a score-ordered prefix —
+    the final tiny block that crosses at the wave's collect boundary.
+    """
+    spec = P(SHARD_AXIS)
+
+    def one_query(di, pl, sl, va, fw, rq, ng, sc, ct, tb, sr, dl, ql,
+                  nd, ft, so, dh, dlo, sh, nc):
+        n_matched, ts, ti = score_core(
+            di, pl, sl, va, fw, rq, ng, sc, ct, tb, sr, dl, ql, nd,
+            n_positions=n_positions, topk=local_k, filt=ft, sortc=so,
+            use_filter=use_filter, use_sort=use_sort)
+        # pad-candidate hits (idx ≥ this shard's real count) score 0
+        ts = jnp.where(ti < nc, ts, 0.0)
+        return (n_matched.astype(jnp.uint32), ts, jnp.take(dh, ti),
+                jnp.take(dlo, ti), jnp.take(sh, ti))
+
+    def per_shard(di, pl, sl, va, fw, rq, ng, sc, ct, tb, sr, dl, ql,
+                  nd, ft, so, dh, dlo, sh, nc):
+        # strip the unit shard axis, run the Msg39 intersect for the
+        # whole batch on this shard's chip
+        nm, ts, hh, ll, shh = jax.vmap(one_query)(
+            di[0], pl[0], sl[0], va[0], fw[0], rq[0], ng[0], sc[0],
+            ct[0], tb[0], sr[0], dl[0], ql[0], nd[0], ft[0], so[0],
+            dh[0], dlo[0], sh[0], nc[0])
+        # Msg3a as an ICI collective: every shard's [B, k] block
+        g_nm = jax.lax.all_gather(nm, SHARD_AXIS)    # [S, B]
+        g_sc = jax.lax.all_gather(ts, SHARD_AXIS)    # [S, B, k]
+        g_hh = jax.lax.all_gather(hh, SHARD_AXIS)
+        g_ll = jax.lax.all_gather(ll, SHARD_AXIS)
+        g_sh = jax.lax.all_gather(shh, SHARD_AXIS)
+
+        def merge_one(sc_q, hh_q, ll_q, sh_q, nm_q):
+            n_kept, n_drop, hi, lo, shq, scq, cum = merge_dedup_topk(
+                sc_q, hh_q, ll_q, sh_q, out_k,
+                max_per_site=MAX_PER_SITE)
+            pad = out_k - scq.shape[0]
+            if pad:
+                z = jnp.zeros(pad, jnp.uint32)
+                hi, lo, shq, cum = (jnp.concatenate([a, z]) for a in
+                                    (hi, lo, shq, cum))
+                scq = jnp.concatenate([scq, jnp.zeros(pad,
+                                                      jnp.float32)])
+            # explicit uint32 on the reductions: x64 mode promotes
+            # uint32 sums to uint64, which would widen the whole row
+            return jnp.concatenate([
+                jnp.atleast_1d(jnp.sum(nm_q).astype(jnp.uint32)),
+                jnp.atleast_1d(n_kept), jnp.atleast_1d(n_drop),
+                hi, lo, shq,
+                jax.lax.bitcast_convert_type(scq, jnp.uint32),
+                cum]).astype(jnp.uint32)
+
+        return jax.vmap(merge_one, in_axes=(1, 1, 1, 1, 1))(
+            g_sc, g_hh, g_ll, g_sh, g_nm)
+
+    return _shard_map(per_shard, mesh=mesh, in_specs=(spec,) * 20,
+                      out_specs=P())(
+        doc_idx, payload, slot, valid, freq_weight, required, negative,
+        scored, counts, table, siterank, doclang, qlang, n_docs, filt,
+        sortc, dochi, doclo, shash, n_cand)
+
+
+#: query-batch bucket floor (waves pad to the next power of two so the
+#: mesh program's B static revisits compiled shapes)
+B_FLOOR = 4
+
+#: over-fetch factor c of the in-program recall ladder: the first
+#: merge window is k·c so a page's worth of 2-per-site survivors
+#: usually exists without escalation (SURVEY §7 hard part (c))
+OVERFETCH_C = 2
+
+
+@dataclass
+class _MeshWave:
+    """One dispatched mesh program (a sub-wave of a ticket: plans
+    sharing the filter/sort statics). ``args`` keeps the staged device
+    operands so the recall escalation re-merges WITHOUT re-packing or
+    re-staging — only the merge window (``out_k``) regrows."""
+    out: object           # replicated device output [B, 3 + 5·out_k]
+    args: dict            # sharded device operands
+    qidx: list            # plan indices served by this wave
+    local_k: int
+    out_k: int
+    max_out: int
+    use_filter: bool
+    use_sort: bool
+
+
+@dataclass
+class MeshPending:
+    plans: list
+    want: int
+    waves: list
+
+
+class MeshServeIndex:
+    """The mesh wave engine behind :class:`MeshResident`'s serving
+    path — a ResidentLoop-compatible index (duck type: ``issue_batch``
+    / ``collect_batch`` / ``_built_version`` + ``sitehash_of`` /
+    ``langid_of``) whose issue dispatches ONE ``shard_map`` program
+    across all chips per ticket wave.
+
+    The serving replica set and per-twin posdb versions are frozen
+    into ``_built_version`` at build; the loop's drain-before-refresh
+    protocol swaps in a fresh index (new twins, new corpus) between
+    waves, never under one. Needs ≥ n_shards visible devices (CI
+    forces 8 host devices via XLA_FLAGS, conftest.py)."""
+
+    def __init__(self, sc: ShardedCollection, mesh=None):
+        self.sc = sc
+        self.mesh = mesh if mesh is not None else make_mesh(sc.n_shards)
+        self._built_version = mesh_generation(sc)
+        serving = sc.hostmap.serving_vector()
+        #: pack-time read set: the serving twin per shard, None where
+        #: the whole shard is down (its block degrades to the empty
+        #: Msg39 reply and the answer is flagged degraded)
+        self.colls = [sc.grid[s][r] if r is not None else None
+                      for s, r in enumerate(serving)]
+        self.degraded = any(c is None for c in self.colls)
+        self.total_docs = sc.num_docs
+
+    # --- host-side post-processing lookups (Msg20/Msg51 point reads) ---
+
+    def _home(self, docid: int) -> Collection | None:
+        return self.colls[int(self.sc.hostmap.shard_of_docid(docid))]
+
+    def sitehash_of(self, docid: int) -> int:
+        c = self._home(docid)
+        if c is None:
+            return 0
+        sh, _ = _cand_site_cols(c, np.asarray([docid], np.uint64))
+        return int(sh[0])
+
+    def langid_of(self, docid: int) -> int:
+        c = self._home(docid)
+        if c is None:
+            return 0
+        _, lg = _cand_site_cols(c, np.asarray([docid], np.uint64))
+        return int(lg[0])
+
+    # --- the issue/collect split the ResidentLoop drives ---------------
+
+    def issue_batch(self, queries, topk: int = 64, lang: int = 0
+                    ) -> MeshPending:
+        """Pack the wave (host), stage it onto the mesh, dispatch the
+        program — returns without blocking on device results."""
+        plans = [q if isinstance(q, QueryPlan) else
+                 compile_query(q, lang=lang) for q in queries]
+        want = max(int(topk), 1)
+        # sub-waves by the program's filter/sort statics (a mixed
+        # ticket still dispatches before any collect)
+        groups: dict[tuple, list[int]] = {}
+        for i, plan in enumerate(plans):
+            key = (bool(plan.filters), plan.sortby is not None)
+            groups.setdefault(key, []).append(i)
+        waves = []
+        for (use_f, use_s), qidx in groups.items():
+            wave = self._issue_wave([plans[i] for i in qidx], qidx,
+                                    want, use_f, use_s)
+            waves.append(wave)
+        return MeshPending(plans=plans, want=want, waves=waves)
+
+    def _issue_wave(self, plans, qidx, want, use_f, use_s):
+        sc = self.sc
+        S = sc.n_shards
+        per_q = []      # (packs[s] | None, freqw) per plan
+        for plan in plans:
+            sort_base = None
+            if plan.sortby is not None:
+                from ..query.packer import local_sort_base
+                bases = [b for c in self.colls if c is not None
+                         and (b := local_sort_base(c, *plan.sortby))
+                         is not None]
+                sort_base = min(bases) if bases else 0.0
+            preps = [prepare_query(c, plan, sort_base=sort_base)
+                     if c is not None else None for c in self.colls]
+            freqw = _global_freq_weights(preps, plan, self.total_docs)
+            per_q.append(([pack_pass(p) if p is not None else None
+                           for p in preps], freqw))
+        live = [p for packs, _ in per_q for p in packs if p is not None]
+        if not live:
+            return _MeshWave(out=None, args={}, qidx=list(qidx),
+                             local_k=0, out_k=0, max_out=0,
+                             use_filter=use_f, use_sort=use_s)
+        # fleet-wide buckets across the whole wave: rectangular
+        # [S, B, ...] stacks, one compiled program per bucket tuple
+        T = max(p.doc_idx.shape[0] for p in live)
+        L = max(p.doc_idx.shape[1] for p in live)
+        D = max(len(p.siterank) for p in live)
+        local_k = min(_bucket(max(want, 64), 64), D)
+        B = _bucket(max(len(plans), 1), B_FLOOR)
+        rows = []   # per padded-query: (packs[s], plan, freqw)
+        for (packs, freqw), plan in zip(per_q, plans):
+            rows.append(([_pad_packed(p, T, L, D, plan, freqw)
+                          for p in packs], plan, freqw))
+        while len(rows) < B:    # pad the batch with empty queries
+            plan, freqw = plans[0], per_q[0][1]
+            rows.append(([_pad_packed(None, T, L, D, plan, freqw)
+                          for _ in range(S)], plan, freqw))
+
+        def cand_cols(s, packs):
+            cand = packs[s].cand_docids
+            hi = np.zeros(D, np.uint32)
+            lo = np.zeros(D, np.uint32)
+            sh = np.zeros(D, np.uint32)
+            d = len(cand)
+            if d and self.colls[s] is not None:
+                hi[:d] = (cand >> np.uint64(32)).astype(np.uint32)
+                lo[:d] = (cand & np.uint64(0xFFFFFFFF)).astype(
+                    np.uint32)
+                sh[:d], _ = _cand_site_cols(self.colls[s], cand)
+            return hi, lo, sh, d
+
+        stack = lambda f: np.stack(
+            [np.stack([f(packs[s]) for packs, _, _ in rows])
+             for s in range(S)])
+        cols = [[cand_cols(s, packs) for packs, _, _ in rows]
+                for s in range(S)]
+        args = dict(
+            doc_idx=stack(lambda p: p.doc_idx),
+            payload=stack(lambda p: p.payload),
+            slot=stack(lambda p: p.slot),
+            valid=stack(lambda p: p.valid),
+            freq_weight=stack(lambda p: p.freq_weight),
+            required=stack(lambda p: p.required),
+            negative=stack(lambda p: p.negative),
+            scored=stack(lambda p: p.scored),
+            counts=stack(lambda p: p.counts),
+            table=stack(lambda p: p.table),
+            siterank=stack(lambda p: p.siterank),
+            doclang=stack(lambda p: p.doclang),
+            qlang=np.stack([np.asarray([plan.lang for _, plan, _
+                                        in rows], np.int32)] * S),
+            n_docs=stack(lambda p: np.int32(p.n_docs)),
+            filt=stack(lambda p: p.filt if p.filt is not None
+                       else np.zeros(len(p.siterank), bool)),
+            sortc=stack(lambda p: p.sortc if p.sortc is not None
+                        else np.zeros(len(p.siterank), np.float32)),
+            dochi=np.stack([np.stack([c[0] for c in cs])
+                            for cs in cols]),
+            doclo=np.stack([np.stack([c[1] for c in cs])
+                            for cs in cols]),
+            shash=np.stack([np.stack([c[2] for c in cs])
+                            for cs in cols]),
+            n_cand=np.stack([np.asarray([c[3] for c in cs], np.int32)
+                             for cs in cols]),
+        )
+        sharded_args = {
+            name: jax.device_put(
+                a, NamedSharding(self.mesh,
+                                 P(SHARD_AXIS, *([None] * (a.ndim - 1)))))
+            for name, a in args.items()
+        }
+        max_out = S * local_k
+        out_k = min(_bucket(max(OVERFETCH_C * want, 64), 64), max_out)
+        wave = _MeshWave(out=None, args=sharded_args, qidx=list(qidx),
+                         local_k=local_k, out_k=out_k, max_out=max_out,
+                         use_filter=use_f, use_sort=use_s)
+        wave.out = self._dispatch(wave)
+        return wave
+
+    def _dispatch(self, wave: _MeshWave):
+        a = wave.args
+        return _mesh_serve(
+            self.mesh, a["doc_idx"], a["payload"], a["slot"],
+            a["valid"], a["freq_weight"], a["required"], a["negative"],
+            a["scored"], a["counts"], a["table"], a["siterank"],
+            a["doclang"], a["qlang"], a["n_docs"], a["filt"],
+            a["sortc"], a["dochi"], a["doclo"], a["shash"],
+            a["n_cand"], local_k=wave.local_k, out_k=wave.out_k,
+            use_filter=wave.use_filter, use_sort=wave.use_sort)
+
+    def collect_batch(self, pending: MeshPending):
+        """Block on the wave's device output; escalate the merge window
+        (×4 out_k, same staged operands — the in-program Msg40 recall
+        loop) while a query's survivor prefix is short of ``want`` AND
+        its window was fully live. One device fetch per round.
+
+        Returns per plan: ``(docids, scores, total_matches, clustered,
+        sitehash)`` — survivors only, already site-deduped."""
+        want = pending.want
+        results: list = [None] * len(pending.plans)
+        empty = (np.empty(0, np.uint64), np.empty(0, np.float32), 0, 0,
+                 np.empty(0, np.uint32))
+        for wave in pending.waves:
+            if wave.out is None:        # every shard down
+                for qi in wave.qidx:
+                    results[qi] = empty
+                continue
+            while True:
+                # the mesh wave's ONE blessed host sync (the collect
+                # boundary — jitwatch BOUNDARY_SITES lists this file)
+                out = np.asarray(jax.device_get(wave.out))  # osselint: ignore[device-sync] — wave collect boundary
+                K = wave.out_k
+                need_more = False
+                for row, qi in zip(out, wave.qidx):
+                    n_kept = int(row[1])
+                    n_drop = int(row[2])
+                    if (n_kept < want and n_kept + n_drop >= K
+                            and K < wave.max_out):
+                        need_more = True
+                        break
+                if not need_more:
+                    break
+                wave.out_k = min(_bucket(wave.out_k * 4, 64),
+                                 wave.max_out)
+                wave.out = self._dispatch(wave)
+            for row, qi in zip(out, wave.qidx):
+                total = int(row[0])
+                n_kept = int(row[1])
+                n_drop = int(row[2])
+                hh = row[3:3 + K].astype(np.uint64)
+                ll = row[3 + K:3 + 2 * K].astype(np.uint64)
+                sh = row[3 + 2 * K:3 + 3 * K].astype(np.uint32)
+                scs = row[3 + 3 * K:3 + 4 * K].view(np.float32)
+                cum = row[3 + 4 * K:3 + 5 * K]
+                # the greedy walk's clustered counter at the page cut:
+                # cumdrop is EXCLUSIVE, so survivor want-1 carries the
+                # drops the host walk would have counted before its
+                # topk-th accept (it breaks at the top of the next
+                # iteration, build_results)
+                clustered = (n_drop if n_kept < want
+                             else int(cum[want - 1]))
+                docids = (hh << np.uint64(32)) | ll
+                results[qi] = (docids[:n_kept],
+                               scs[:n_kept].astype(np.float32),
+                               total, clustered, sh[:n_kept])
+        return results
+
+
 class MeshResident:
     """The PRODUCTION resident index on a device mesh: one
     HBM-resident :class:`~..query.devindex.DeviceIndex` per shard,
@@ -661,16 +1070,22 @@ class MeshResident:
     follow the committed operands' device; the host thread pool only
     overlaps the dispatch+fetch round trips).
 
-    Architecture note (why the merge seam is host-side here): each
-    shard routes every query adaptively (F1 κ rung vs direct-cube) by
-    ITS OWN term statistics and runs its own lossless escalation
-    ladder, so the per-shard execution is a host-driven loop — exactly
-    the reference's Msg39 boundary (``Msg39.cpp:74``), where each host
-    intersects independently and Msg3a merges the tiny top-k replies
-    (``Msg3a.cpp:971``). The k-way merge of S·k (docid, score) rows is
-    microseconds of numpy; the in-mesh all-gather merge remains on the
-    ``sharded_search`` path where the per-shard program is a single
-    fused kernel. Cross-shard score comparability holds because every
+    Two merge seams coexist here, and which one serves is a mode:
+
+    * ``search_batch`` — the HOST merge: each shard routes every query
+      adaptively (F1 κ rung vs direct-cube) by ITS OWN term statistics
+      and runs its own lossless escalation ladder, a host-driven loop
+      per shard — the reference's Msg39 boundary (``Msg39.cpp:74``)
+      with Msg3a merging the tiny top-k replies in numpy.
+    * ``serve_batch`` — the MESH-RESIDENT path (the production serving
+      mode): one :func:`_mesh_serve` ``shard_map`` program per ticket
+      wave under a :class:`~..query.resident.ResidentLoop`, with the
+      Msg3a merge, the 2-per-site dedup AND the recall over-fetch all
+      in-jit — no host hop between shard search and merge; only the
+      final [B, k] (docid, score, sitehash) block crosses at the
+      wave's collect boundary.
+
+    Cross-shard score comparability holds on both paths because every
     shard plans with CLUSTER-WIDE term frequencies (global dfs), like
     the reference's Msg39Request termFreqWeights.
     """
@@ -689,6 +1104,13 @@ class MeshResident:
                         for s in range(sc.n_shards)]
         from concurrent.futures import ThreadPoolExecutor
         self._pool = ThreadPoolExecutor(max(sc.n_shards, 1))
+        # cluster-wide df memo (satellite of the mesh-serving PR):
+        # key = termid, valid while every shard's resident base stays
+        # on the generation the memo was filled under
+        self._df_memo: dict[int, int] = {}
+        self._df_memo_gen = None
+        self._serve_idx: MeshServeIndex | None = None
+        self._serve_loop = None
 
     def refresh(self) -> None:
         for di in self.indexes:
@@ -698,7 +1120,20 @@ class MeshResident:
         list(self._pool.map(lambda di: di.warm(), self.indexes))
 
     def _global_df(self, termid: int) -> int:
-        return sum(di._df_of(termid) for di in self.indexes)
+        """Cluster-wide document frequency, memoized per (termid,
+        resident-base generation tuple): repeated terms — every wave
+        re-plans the same hot query words — pay the S per-shard
+        ``_df_of`` walks ONCE per corpus generation instead of per
+        plan."""
+        gen = tuple(di.df_generation for di in self.indexes)
+        if gen != self._df_memo_gen:
+            self._df_memo.clear()
+            self._df_memo_gen = gen
+        df = self._df_memo.get(termid)
+        if df is None:
+            df = sum(di._df_of(termid) for di in self.indexes)
+            self._df_memo[termid] = df
+        return df
 
     def _global_sort_base(self, fld: str, desc: bool) -> float:
         bases = [b for di in self.indexes
@@ -765,6 +1200,102 @@ class MeshResident:
 
     def search(self, q, **kw) -> SearchResults:
         return self.search_batch([q], **kw)[0]
+
+    # --- the mesh-resident serving path (in-jit Msg3a merge) -----------
+
+    def _serve_index(self) -> MeshServeIndex:
+        """Fresh-or-cached :class:`MeshServeIndex` for the CURRENT mesh
+        generation — the ResidentLoop's ``di_fn``. A write or a twin
+        death moves :func:`mesh_generation`; the loop drains in-flight
+        waves first, then this hands it an index packing from the new
+        serving twins."""
+        idx = self._serve_idx
+        if idx is None or idx._built_version != mesh_generation(self.sc):
+            idx = MeshServeIndex(self.sc)
+            self._serve_idx = idx
+        return idx
+
+    def serve_loop(self):
+        """The mesh ResidentLoop, spawned lazily (and respawned if
+        stopped) — one ticket wave dispatches one mesh program across
+        all chips."""
+        from ..query.resident import ResidentLoop
+        loop = self._serve_loop
+        if loop is not None and loop.alive:
+            return loop
+        loop = ResidentLoop(self._serve_index,
+                            gen_fn=lambda: mesh_generation(self.sc),
+                            name=f"mesh-{self.sc.name}")
+        self._serve_loop = loop
+        return loop
+
+    def serve_batch(self, queries, topk: int = 10, lang: int = 0,
+                    offset: int = 0, with_snippets: bool = True,
+                    site_cluster: bool = True,
+                    results_lock=None) -> list[SearchResults]:
+        """The mesh-resident serving path: submit one ticket, get back
+        already-merged, already-site-deduped survivors (plus the
+        program's clustered counter), run only the shared Msg40 tail
+        (summaries/PQR/facets) on the host.
+
+        ``site_cluster=False`` has no in-program variant (the dedup is
+        part of the compiled merge) — it routes through the host-merge
+        ``search_batch``. ``results_lock`` guards ONLY the host
+        post-processing, like ``search_device_batch``."""
+        if not site_cluster:
+            return self.search_batch(queries, topk=topk, lang=lang,
+                                     offset=offset,
+                                     with_snippets=with_snippets,
+                                     site_cluster=False)
+        import contextlib
+        from ..query.engine import (PQR_SCAN, compute_facets,
+                                    finish_page)
+        sc = self.sc
+        plans = [q if isinstance(q, QueryPlan) else
+                 compile_query(q, lang=lang) for q in queries]
+        want = max(topk + offset, PQR_SCAN)
+        ticket = self.serve_loop().submit(plans, topk=want, lang=lang)
+        raw = ticket.wait()
+        msi = ticket.di     # the index the wave actually ran against
+        out = []
+        lock_ctx = results_lock if results_lock is not None \
+            else contextlib.nullcontext()
+        with lock_ctx:
+            for plan, (docids, scores, total, clustered, shash) in \
+                    zip(plans, raw):
+                site_map = {int(d): int(h)
+                            for d, h in zip(docids, shash)}
+                # survivors are already ≤ MAX_PER_SITE per site; the
+                # host walk re-counts only drops the program cannot
+                # see (content-hash dedup freeing a site slot)
+                results, host_cl = build_results(
+                    sc.get_document, docids, scores, plan, topk=want,
+                    with_snippets=False, site_cluster=True,
+                    site_of=lambda d: site_map.get(int(d), 0))
+                page = finish_page(
+                    results, offset=offset, topk=topk,
+                    conf=sc.shards[0].conf, qlang=plan.lang,
+                    langid_of=msi.langid_of, get_doc=sc.get_document,
+                    words=plan.match_words(),
+                    with_snippets=with_snippets)
+                out.append(SearchResults(
+                    query=plan.raw, total_matches=total, results=page,
+                    clustered=clustered + host_cl,
+                    degraded=msi.degraded,
+                    suggestion=suggest_sharded(sc, plan)
+                    if total == 0 else None,
+                    facets=compute_facets(plan, docids,
+                                          sc.get_document)))
+        return out
+
+    def serve(self, q, **kw) -> SearchResults:
+        return self.serve_batch([q], **kw)[0]
+
+    def stop(self) -> None:
+        """Tear down the serving loop + shard pool (server shutdown)."""
+        if self._serve_loop is not None:
+            self._serve_loop.stop()
+        self._pool.shutdown(wait=False)
 
 
 def suggest_sharded(sc: ShardedCollection, plan: QueryPlan) -> str | None:

@@ -1160,6 +1160,15 @@ class DeviceIndex:
                             self.Nb + pa, pb - pa))
         return out
 
+    @property
+    def df_generation(self):
+        """The posdb version this resident base was built from — the
+        memo key for cluster-wide df caches (``MeshResident._global_df``
+        sums ``_df_of`` across every shard; a shard's sum is stable
+        until ITS base moves, so the tuple of these across shards keys
+        the whole memo)."""
+        return self._built_version
+
     def _df_of(self, termid: int) -> int:
         """Exact document frequency under pending deletes/re-adds:
         base df − superseded-doc pairs + delta df."""

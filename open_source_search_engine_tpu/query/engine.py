@@ -435,6 +435,24 @@ def get_resident_loop(coll: Collection):
     return loop
 
 
+def get_mesh_resident(sc):
+    """The ShardedCollection's :class:`~..parallel.sharded.MeshResident`
+    (mesh-resident serving: per-shard HBM bases + the in-jit Msg3a
+    merge under a ResidentLoop), created lazily like the flat device
+    index. Imported lazily — parallel.sharded imports this module at
+    load."""
+    from ..parallel.sharded import MeshResident
+    mr = getattr(sc, "_mesh_resident", None)
+    if mr is not None:
+        return mr
+    with _DI_CREATE_LOCK:
+        mr = getattr(sc, "_mesh_resident", None)
+        if mr is None:
+            mr = MeshResident(sc)
+            sc._mesh_resident = mr
+    return mr
+
+
 def search_device_batch(coll: Collection, queries, *, topk: int = 10,
                         lang: int = 0, with_snippets: bool = True,
                         site_cluster: bool = True, offset: int = 0,

@@ -26,6 +26,18 @@ after the write is guaranteed to be issued against a refreshed base
 (``Ticket.generation`` records which). Refreshing mid-flight would be
 worse than stale: ``refresh()`` donates the packed buffers a dispatched
 wave is still reading.
+
+The issue/collect split is mesh-aware by construction: the loop is
+generic over any index duck-typing ``issue_batch(plans, topk, lang) →
+pending`` / ``collect_batch(pending)`` / ``_built_version`` (any
+equality-comparable value). The single-chip plane drives a
+``DeviceIndex``; the mesh serving plane drives a
+:class:`~..parallel.sharded.MeshServeIndex`, whose issue dispatches ONE
+``shard_map`` program across all chips per ticket wave and whose
+generation is the (corpus, serving-topology, per-twin version) tuple —
+so a twin death rides the same drain-before-refresh protocol: in-flight
+waves finish on the base they were packed from, the next wave packs
+from the surviving twin, and no ticket is ever lost to a failover.
 """
 
 from __future__ import annotations
@@ -62,10 +74,11 @@ QUEUE_ENTRY_COST = 2048
 class Ticket:
     """One submit()'s handle: wait() blocks until the loop resolves it.
 
-    After resolution, ``di`` is the DeviceIndex the wave actually ran
-    against and ``generation`` its ``_built_version`` at issue time —
-    callers use ``di`` for post-processing (sitehash/langid lookups
-    must come from the same snapshot that scored)."""
+    After resolution, ``di`` is the index the wave actually ran
+    against (DeviceIndex or MeshServeIndex) and ``generation`` its
+    ``_built_version`` at issue time — callers use ``di`` for
+    post-processing (sitehash/langid lookups must come from the same
+    snapshot that scored)."""
 
     __slots__ = ("plans", "topk", "lang", "deadline", "di",
                  "generation", "_ev", "_res", "_err")
@@ -124,6 +137,7 @@ class ResidentLoop:
                  max_queue: int = MAX_QUEUE):
         self._di_fn = di_fn
         self._gen_fn = gen_fn
+        self.name = name
         self._max_batch = max_batch
         self._max_queue = max_queue
         self._cv = threading.Condition()

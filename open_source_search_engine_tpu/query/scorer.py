@@ -324,6 +324,57 @@ def score_core(doc_idx, payload, slot, valid, freq_weight, required,
 score_and_topk = jax.jit(score_core, static_argnames=("n_positions", "topk"))
 
 
+def merge_dedup_topk(g_scores, g_hi, g_lo, g_sh, out_k: int,
+                     max_per_site: int = 2):
+    """The Msg3a merge tail for ONE query, pure traced — global top-k
+    over the all-gathered per-shard candidate blocks, then the
+    clusterdb 2-per-site dedup (Msg51 semantics) applied IN-PROGRAM so
+    the recall decision needs no host round trip.
+
+    ``g_scores``/``g_hi``/``g_lo``/``g_sh`` are the gathered ``[S, k]``
+    blocks (scores, docid halves, sitehash). Returns, each over the
+    merged window ``kk = min(out_k, S·k)`` with survivors compacted to
+    a prefix in score order: (n_kept, n_dropped, hi, lo, sitehash,
+    scores, cumdrop) — ``cumdrop[i]`` is the EXCLUSIVE count of
+    clustered-away rows above survivor row i, which lets the host
+    reconstruct the greedy walk's clustered counter at any page cut.
+
+    Parity contract with :func:`..query.engine.build_results`: the
+    greedy accept-walk keeps a row iff fewer than ``max_per_site``
+    same-site rows were ACCEPTED above it; since only the first
+    ``max_per_site`` same-site occurrences are ever accepted, that is
+    equivalent to "fewer than ``max_per_site`` same-site LIVE rows
+    above it" — an order-independent rank computable as one masked
+    [kk, kk] triangular sum. sitehash 0 (no clusterdb record) is
+    exempt, exactly like the host walk's ``if sh`` gate."""
+    flat = g_scores.reshape(-1)
+    kk = min(out_k, flat.shape[0])
+    m_sc, m_pos = jax.lax.top_k(flat, kk)
+    m_hi = jnp.take(g_hi.reshape(-1), m_pos)
+    m_lo = jnp.take(g_lo.reshape(-1), m_pos)
+    m_sh = jnp.take(g_sh.reshape(-1), m_pos)
+    live = m_sc > 0.0
+    # occ[i] = # live same-site rows strictly above i (top_k output is
+    # already score-descending, ties by gather position — the same
+    # order the host merge's stable argsort visits)
+    same = (m_sh[:, None] == m_sh[None, :]) & live[None, :]
+    earlier = jnp.tril(jnp.ones((kk, kk), jnp.bool_), k=-1)
+    occ = jnp.sum(same & earlier, axis=1)
+    keep = live & ((m_sh == 0) | (occ < max_per_site))
+    dropped = live & ~keep
+    drop32 = dropped.astype(jnp.uint32)
+    cumdrop = jnp.cumsum(drop32) - drop32  # exclusive scan
+    rank = jnp.arange(kk)
+    # stable compaction: survivors first (score order preserved),
+    # clustered + dead rows pushed past the survivor prefix
+    order = jnp.argsort(jnp.where(keep, rank, kk + rank))
+    sc_s = jnp.where(jnp.take(keep, order), jnp.take(m_sc, order), 0.0)
+    return (jnp.sum(keep).astype(jnp.uint32),
+            jnp.sum(drop32).astype(jnp.uint32),
+            jnp.take(m_hi, order), jnp.take(m_lo, order),
+            jnp.take(m_sh, order), sc_s, jnp.take(cumdrop, order))
+
+
 def _score_packed_out(*args, n_positions: int, topk: int,
                       use_filter: bool = False, use_sort: bool = False):
     """score_core with the three outputs packed into ONE uint32 vector:

@@ -813,9 +813,18 @@ class SearchHTTPServer:
                 q, topk=n, offset=s,
                 conf=rc_coll.conf if rc_coll else None)
         elif self.sharded is not None:
-            from ..parallel import sharded_search
-            with self._lock:
-                res = sharded_search(self.sharded, q, topk=n, offset=s)
+            if self.conf.serve_mesh:
+                # mesh-resident serving: the ticket wave dispatches ONE
+                # shard_map program across all chips (in-jit Msg3a merge
+                # + site dedup); the ResidentLoop serializes device
+                # work, so the lock guards only host post-processing
+                res = engine.get_mesh_resident(self.sharded).serve(
+                    q, topk=n, offset=s, results_lock=self._lock)
+            else:
+                from ..parallel import sharded_search
+                with self._lock:
+                    res = sharded_search(self.sharded, q, topk=n,
+                                         offset=s)
         elif self.conf.serve_device:
             # resident-index path through the micro-batcher: concurrent
             # requests share one vmapped dispatch
@@ -1722,6 +1731,12 @@ class SearchHTTPServer:
             loop = getattr(self.colldb.get(cn), "_resident_loop", None)
             if loop is not None:
                 loop.stop()
+        if self.sharded is not None:
+            # mesh serving plane: stop its loop too (lazily respawned
+            # by MeshResident.serve_loop on restart)
+            mr = getattr(self.sharded, "_mesh_resident", None)
+            if mr is not None:
+                mr.stop()
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -64,6 +64,7 @@ PARMS: list[Parm] = [
     _p("ssl_cert", "sslcert", str, "", GLOBAL, "TLS certificate chain path (gb.pem role, TcpServer.cpp SSL) — empty serves plaintext", broadcast=False),
     _p("ssl_key", "sslkey", str, "", GLOBAL, "TLS private key path (empty = key inside ssl_cert)", broadcast=False),
     _p("serve_device", "sdev", bool, True, GLOBAL, "serve /search from the HBM-resident index with micro-batching (SURVEY §7.8 throughput mode)"),
+    _p("serve_mesh", "smesh", bool, False, GLOBAL, "sharded instances serve /search through the mesh-resident path: one shard_map program per wave, Msg3a merge + site dedup in-jit (SURVEY §7 stage 4/5)"),
     _p("merge_quiet_hours", "mergehours", str, "", GLOBAL, "DailyMerge window (DailyMerge.h:11)"),
     _p("alert_cmd", "alertcmd", str, "", GLOBAL, "command run on host death/recovery with OSSE_ALERT_* env (PingServer.h:77 email/SMS role); empty = log only", broadcast=False),
     _p("trace_sample", "tsample", int, 64, GLOBAL, "head-sample 1 in N query traces (utils.trace, Dapper-style); 1 = every query, 0 = tracing off"),

@@ -1,0 +1,13 @@
+# osselint: path=open_source_search_engine_tpu/parallel/sharded.py
+# negative fixture: parallel/sharded.py IS the mesh plane — the
+# shard_map merge program may use cross-chip collectives freely.
+# Never scanned by the real linter.
+import jax
+import jax.numpy as jnp
+
+
+def mesh_merge(local_scores, out_k):
+    gathered = jax.lax.all_gather(local_scores, "shards")
+    total = jax.lax.psum(jnp.sum(local_scores), axis_name="shards")
+    merged, _pos = jax.lax.top_k(gathered.reshape(-1), out_k)
+    return merged, total

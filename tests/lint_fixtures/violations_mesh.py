@@ -1,0 +1,34 @@
+# osselint: path=open_source_search_engine_tpu/query/mesh_fixture.py
+# osselint fixture — re-scoped to a virtual query/ path: cross-shard
+# collectives are banned everywhere outside parallel/sharded.py, and
+# the per-shard kernel layer is exactly where a stray one would
+# couple the scorer to the mesh shape. Never scanned by the real
+# linter (lint_fixtures/ is excluded from directory walks).
+import jax
+import jax.numpy as jnp
+from jax.lax import all_gather
+
+
+def merged_scores(local_scores):
+    return jax.lax.all_gather(local_scores, "shards")  # EXPECT mesh-collective
+
+
+def global_df(local_df):
+    return jax.lax.psum(local_df, axis_name="shards")  # EXPECT mesh-collective
+
+
+def mean_latency(lat):
+    return jax.lax.pmean(lat, "shards")  # EXPECT mesh-collective
+
+
+def bare_import_form(block):
+    # the from-import spelling must not slip through tail matching
+    return all_gather(block, "shards")  # EXPECT mesh-collective
+
+
+def local_topk_is_fine(scores, k):
+    return jax.lax.top_k(scores, k)
+
+
+def plain_math_is_fine(x):
+    return jnp.sum(x)

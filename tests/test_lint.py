@@ -138,6 +138,22 @@ class TestSeededRegressions:
             src,
             "open_source_search_engine_tpu/parallel/transport.py") == []
 
+    def test_mesh_collective_outside_mesh_plane_is_caught(self):
+        # the mesh-serving PR's layering rule: the Msg3a merge program
+        # in parallel/sharded.py is the ONE home for ICI collectives —
+        # a stray all_gather in the scorer couples the flat single-chip
+        # kernel to the serving mesh shape
+        src = ("import jax\n"
+               "def merge(scores):\n"
+               "    return jax.lax.all_gather(scores, 'shards')\n")
+        found = osselint.check_source(
+            src, "open_source_search_engine_tpu/query/scorer.py")
+        assert [f.rule for f in found] == ["mesh-collective"]
+        # ...but the mesh plane itself is the sanctioned home
+        assert osselint.check_source(
+            src,
+            "open_source_search_engine_tpu/parallel/sharded.py") == []
+
     def test_bare_stats_timed_on_query_path_is_caught(self):
         src = ("def search(q):\n"
                "    with g_stats.timed('query.total'):\n"

@@ -245,3 +245,201 @@ class TestMeshResident:
             s = mr.search(q, topk=10, with_snippets=False)
             assert [r.docid for r in s.results] == \
                 [r.docid for r in b.results]
+
+
+# distinct per-doc term frequencies: the two merge paths order exact
+# score TIES differently (host stable-argsort over shard concat vs
+# in-jit top_k over the gathered blocks), so dedup-parity corpora must
+# make every score unique
+DISTINCT_DOCS = {
+    f"http://site{i % 5}.example.com/d{i}":
+        "<html><title>Doc number %d</title><body><p>%s</p></body></html>"
+        % (i, "apple " * (1 + i) + "banana " * (1 + (i * 3) % 11)
+           + f"tok{i} gem ")
+    for i in range(20)
+}
+
+
+class TestMeshServe:
+    """The mesh-RESIDENT serving path: Msg3a merge + 2-per-site dedup
+    inside one shard_map program, driven by a ResidentLoop (this PR's
+    tentpole). Parity contract: bit-identical to the host-merge
+    MeshResident and the flat engine."""
+
+    @pytest.fixture(scope="class")
+    def dsc(self, tmp_path_factory):
+        s = ShardedCollection("dmesh", tmp_path_factory.mktemp("dmesh"),
+                              n_shards=4)
+        for _row in s.grid:
+            for _c in _row:
+                _c.conf.pqr_enabled = False
+        for url, html in DISTINCT_DOCS.items():
+            s.index_document(url, html)
+        return s
+
+    @pytest.fixture(scope="class")
+    def dflat(self, tmp_path_factory):
+        c = Collection("dflat", tmp_path_factory.mktemp("dflat"))
+        c.conf.pqr_enabled = False
+        for url, html in DISTINCT_DOCS.items():
+            docproc.index_document(c, url, html)
+        return c
+
+    @pytest.fixture(scope="class")
+    def mr(self, dsc):
+        from open_source_search_engine_tpu.parallel.sharded import \
+            MeshResident
+        m = MeshResident(dsc)
+        yield m
+        m.stop()
+
+    def test_three_way_parity(self, mr, dflat):
+        """flat engine == host-merge MeshResident == in-jit mesh merge,
+        on docids, scores, totals AND site-dedup clustered counts."""
+        from open_source_search_engine_tpu.query.engine import \
+            search_device
+        qs = ["apple banana", "gem", "tok7", "apple gem"]
+        host = mr.search_batch(qs, topk=5, with_snippets=False)
+        meshr = mr.serve_batch(qs, topk=5, with_snippets=False)
+        for q, h, m in zip(qs, host, meshr):
+            f = search_device(dflat, q, topk=5, with_snippets=False)
+            for res in (h, m):
+                assert res.total_matches == f.total_matches, q
+                assert res.clustered == f.clustered, q
+                assert [(r.docid, round(r.score, 3))
+                        for r in res.results] == \
+                       [(r.docid, round(r.score, 3))
+                        for r in f.results], q
+
+    def test_serve_without_site_cluster_routes_host(self, mr):
+        h = mr.search_batch(["apple banana"], topk=8,
+                            with_snippets=False, site_cluster=False)
+        m = mr.serve_batch(["apple banana"], topk=8,
+                           with_snippets=False, site_cluster=False)
+        assert [r.docid for r in m[0].results] == \
+               [r.docid for r in h[0].results]
+        assert m[0].clustered == 0
+
+    def test_mixed_wave_filter_subgroups(self, mr):
+        """A ticket mixing plain and filtered queries splits into
+        sub-waves by the program's statics but resolves in order."""
+        qs = ["apple banana", "apple site:site2.example.com", "gem"]
+        host = mr.search_batch(qs, topk=5, with_snippets=False)
+        meshr = mr.serve_batch(qs, topk=5, with_snippets=False)
+        for q, h, m in zip(qs, host, meshr):
+            assert [r.docid for r in m.results] == \
+                   [r.docid for r in h.results], q
+            assert m.total_matches == h.total_matches, q
+
+    def test_no_match_suggestion(self, mr):
+        res = mr.serve("aple banana", with_snippets=False)
+        assert res.total_matches == 0 and not res.results
+        assert res.suggestion is not None
+
+    def test_overfetch_escalation_recall(self, tmp_path_factory):
+        """The in-program Msg40 recall loop: when a few sites dominate
+        the first k·c merge window, the collect escalates the window
+        (×4, same staged operands) until low-scored unique-site docs
+        surface — parity with the host ladder's re-intersection."""
+        from open_source_search_engine_tpu.parallel.sharded import (
+            MeshResident, MeshServeIndex)
+        s = ShardedCollection("esc", tmp_path_factory.mktemp("esc"),
+                              n_shards=4)
+        for _row in s.grid:
+            for _c in _row:
+                _c.conf.pqr_enabled = False
+        # 4 sites × 45 high-tf docs bury 5 unique-site low-tf docs
+        # past the first out_k window (2·48 → 128 < 180 dominated rows)
+        for i in range(180):
+            s.index_document(
+                f"http://big{i % 4}.example.com/p{i}",
+                "<html><body><p>%s</p></body></html>"
+                % ("needle " * (3 + i % 37) + f"pad{i} "))
+        for i in range(5):
+            s.index_document(
+                f"http://unique{i}.example.com/u{i}",
+                f"<html><body><p>needle solo{i}</p></body></html>")
+        msi = MeshServeIndex(s)
+        pend = msi.issue_batch(["needle"], topk=48)
+        first_k = pend.waves[0].out_k
+        ((docids, scores, total, clustered, shash),) = \
+            msi.collect_batch(pend)
+        assert pend.waves[0].out_k > first_k   # escalation happened
+        assert total == 185
+        # 2 per big site + every unique-site doc survived the dedup
+        assert len(docids) == 4 * 2 + 5
+        assert clustered == 185 - 13
+        mr = MeshResident(s)
+        try:
+            (h,) = mr.search_batch(["needle"], topk=13,
+                                   with_snippets=False)
+            (m,) = mr.serve_batch(["needle"], topk=13,
+                                  with_snippets=False)
+            # the big-site corpus ties scores ACROSS sites (same tf on
+            # four sites), so compare the ranking order-independently
+            key = lambda r: (-round(r.score, 3), r.docid)
+            assert sorted(key(r) for r in m.results) == \
+                   sorted(key(r) for r in h.results)
+            assert m.clustered == h.clustered
+        finally:
+            mr.stop()
+
+    def test_twin_failover_zero_lost_queries(self, tmp_path_factory):
+        """Kill one mesh shard's serving twin mid-serving: the next
+        wave packs from the survivor via the loop's drain-before-
+        refresh — same answers, no ticket lost, then whole-shard death
+        only degrades."""
+        from open_source_search_engine_tpu.parallel.sharded import \
+            MeshResident
+        s = ShardedCollection("fo", tmp_path_factory.mktemp("fo"),
+                              n_shards=4, n_replicas=2)
+        for _row in s.grid:
+            for _c in _row:
+                _c.conf.pqr_enabled = False
+        for url, html in DISTINCT_DOCS.items():
+            s.index_document(url, html)
+        mr = MeshResident(s)
+        try:
+            base = mr.serve("apple banana", topk=5,
+                            with_snippets=False)
+            assert base.results and not base.degraded
+            loop = mr.serve_loop()
+            s.hostmap.mark_dead(0, 0)      # twin 1 takes shard 0 over
+            after = mr.serve("apple banana", topk=5,
+                             with_snippets=False)
+            assert not after.degraded
+            assert [(r.docid, round(r.score, 3))
+                    for r in after.results] == \
+                   [(r.docid, round(r.score, 3)) for r in base.results]
+            assert loop.alive                      # zero lost queries
+            s.hostmap.mark_dead(0, 1)      # whole shard 0 gone
+            deg = mr.serve("apple banana", topk=5, with_snippets=False)
+            assert deg.degraded
+            assert deg.total_matches <= base.total_matches
+            s.hostmap.mark_alive(0, 0)
+            back = mr.serve("apple banana", topk=5, with_snippets=False)
+            assert not back.degraded
+            assert [r.docid for r in back.results] == \
+                   [r.docid for r in base.results]
+        finally:
+            mr.stop()
+
+    def test_generation_moves_on_write_and_on_death(self, dsc):
+        from open_source_search_engine_tpu.parallel.sharded import \
+            mesh_generation
+        g0 = mesh_generation(dsc)
+        assert mesh_generation(dsc) == g0      # stable when idle
+        dsc.hostmap.mark_dead(0, 0)
+        try:
+            assert mesh_generation(dsc) != g0
+        finally:
+            dsc.hostmap.mark_alive(0, 0)
+        assert mesh_generation(dsc) == g0
+
+    def test_global_df_memoized(self, mr):
+        mr.search("apple", with_snippets=False)
+        memo1 = dict(mr._df_memo)
+        assert memo1
+        mr.search("apple banana", with_snippets=False)
+        # apple's df came from the memo, not a re-walk
+        assert all(mr._df_memo[k] == v for k, v in memo1.items())

@@ -14,7 +14,7 @@ python -m tools.osselint
 #    fixtures actually produce findings (the exact-line marker match
 #    lives in tests/test_lint.py)
 python -m tools.osselint tests/lint_fixtures/clean_parallel.py \
-    tests/lint_fixtures/clean_jit.py
+    tests/lint_fixtures/clean_jit.py tests/lint_fixtures/clean_mesh.py
 for f in tests/lint_fixtures/violations_*.py; do
     if python -m tools.osselint "$f" > /dev/null 2>&1; then
         echo "check.sh: $f produced no findings" >&2
@@ -57,5 +57,17 @@ BENCH_LOAD=1 BENCH_LOAD_QPS=6,12 BENCH_LOAD_SECONDS=2 \
 #    2→3 cross-process shard split; exits nonzero unless every gate
 #    holds and no child process survives teardown
 BENCH_FLEET=1 BENCH_FLEET_SECONDS=5 BENCH_FLEET_QPS=8 \
+    JAX_PLATFORMS=cpu python bench.py
+
+# 7. mesh serving smoke: a SHORT scale curve of the in-jit Msg3a merge
+#    (subprocess per point, forced host devices) — gates the 4-shard
+#    in-jit merge's speedup over the single-chip path on the same
+#    corpus, zero compiles/retraces/off-boundary transfers across
+#    varying-batch steady-state mesh waves, and twin failover with
+#    zero lost queries (bench.py main_mesh docstring; full sizes run
+#    nightly via BENCH_MESH=1 defaults)
+BENCH_MESH=1 BENCH_MESH_SHARDS=1,4 BENCH_MESH_DPS=80 \
+    BENCH_MESH_QUERIES=32 BENCH_MESH_JIT_WAVES=24 \
+    BENCH_MESH_FAILOVER_DOCS=60 \
     JAX_PLATFORMS=cpu python bench.py
 echo "check.sh: OK"

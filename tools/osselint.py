@@ -553,6 +553,39 @@ def _device_scope(rel: str) -> bool:
         f"{PKG}/query/devindex.py", f"{PKG}/query/scorer.py")
 
 
+#: cross-chip collectives — the ICI traffic primitives. One module owns
+#: them so the mesh topology (axis names, gather layout, replica
+#: folding) has a single home; a collective elsewhere silently couples
+#: that file to the serving mesh shape
+_MESH_COLLECTIVES = {"all_gather", "psum", "pmean"}
+
+
+def rule_mesh_collective(ctx: Ctx) -> list[Finding]:
+    """``jax.lax.all_gather``/``psum``/``pmean`` outside
+    parallel/sharded.py: cross-shard collectives belong to the mesh
+    plane (the Msg3a merge program), not to per-shard kernels — scorer
+    and devindex code must stay mesh-agnostic so the flat single-chip
+    path runs it unchanged."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        tail = name.split(".")[-1] if name else ""
+        if tail in _MESH_COLLECTIVES:
+            out.append(Finding(
+                ctx.rel, node.lineno, "mesh-collective",
+                f"{tail} outside parallel/sharded.py — cross-shard "
+                "collectives live in the mesh plane; keep per-shard "
+                "kernels mesh-agnostic and merge in the shard_map "
+                "program"))
+    return out
+
+
+def _mesh_collective_scope(rel: str) -> bool:
+    return _in_pkg(rel) and rel != f"{PKG}/parallel/sharded.py"
+
+
 # ---------------------------------------------------------------------------
 # jit trace-discipline family
 # ---------------------------------------------------------------------------
@@ -1105,6 +1138,7 @@ RULES = [
     ("thread-spawn", _thread_scope, rule_thread_spawn),
     ("locked-global", _locked_global_scope, rule_locked_global),
     ("device-sync", _device_scope, rule_device_sync),
+    ("mesh-collective", _mesh_collective_scope, rule_mesh_collective),
     ("jit-unstable-static", _in_pkg, rule_jit_unstable_static),
     ("jit-in-body", _jit_body_scope, rule_jit_in_body),
     ("jit-mutable-closure", _in_pkg, rule_jit_mutable_closure),
