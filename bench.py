@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import sys
 import tempfile
 import time
@@ -2009,6 +2010,193 @@ def main_fleet() -> dict:
     return rep
 
 
+def main_tenants() -> dict:
+    """Tenant-plane gate (BENCH_TENANTS=1): ONE front door serving a
+    Zipf(s=1.5) query mix over BENCH_TENANTS_COLLS collections with a
+    residency budget of BENCH_TENANTS_HOT — far below the collection
+    count, so the ResidencyManager must keep the hot head device-
+    resident while the cold tail churns through promote/park. Legs:
+
+    1. Zipf leg (sequential, seeded, so the LRU trace is reproducible):
+       every arrival must answer 200 with zero admission sheds, the
+       residency hit rate must clear BENCH_TENANTS_HIT_RATE, cold-start
+       p99 must stay under BENCH_TENANTS_COLD_P99_MS (compiles are
+       absorbed on a throwaway collection first, so the bound measures
+       transfer+build, not XLA), the resident count must respect the
+       budget, and the membudget must never refuse (parking IS the
+       relief valve);
+    2. quota leg: a tight swapped-in AdmissionGate(1 inflight/4 queue)
+       while one tenant floods and another trickles — weighted-fair
+       queueing must keep the quiet tenant shed-free while the flood
+       eats quota sheds (including displacement of its own waiters).
+
+    Exits 1 unless EVERY gate holds. Prints ONE JSON line."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import random
+    import threading
+    from collections import Counter
+
+    from open_source_search_engine_tpu.build import docproc
+    from open_source_search_engine_tpu.serve import admission as adm
+    from open_source_search_engine_tpu.serve.server import \
+        SearchHTTPServer
+    from open_source_search_engine_tpu.serve.tenancy import g_residency
+    from open_source_search_engine_tpu.utils.stats import g_stats
+
+    n_colls = int(os.environ.get("BENCH_TENANTS_COLLS", "1000"))
+    hot = int(os.environ.get("BENCH_TENANTS_HOT", "160"))
+    n_q = int(os.environ.get("BENCH_TENANTS_QUERIES", "2000"))
+    hit_gate = float(os.environ.get("BENCH_TENANTS_HIT_RATE", "0.85"))
+    cold_p99_ms = float(os.environ.get("BENCH_TENANTS_COLD_P99_MS",
+                                       "2500"))
+    bdir = tempfile.mkdtemp(prefix="osse_bench_tenants_")
+    srv = SearchHTTPServer(bdir)
+
+    words = "walrus herd colony shore tusk haulout".split()
+    names = [f"t{i:04d}" for i in range(n_colls)]
+    t_build = time.monotonic()
+    for i, name in enumerate(names):
+        coll = srv.colldb.get(name)
+        # cache off so every request reaches the engine (the leg
+        # measures RESIDENCY hits, not the result cache); pqr off so
+        # a cold start is index build + transfer, nothing else
+        coll.conf.result_cache_ttl = 0
+        coll.conf.pqr_enabled = False
+        docproc.index_document(
+            coll, f"http://tenants.test/{name}",
+            f"<html><body><p>{' '.join(words)} doc{i}</p>"
+            "</body></html>")
+    build_s = time.monotonic() - t_build
+
+    # absorb the one-time JAX compile on a throwaway tenant, then wipe
+    # the residency ledger so the timed leg starts cold and its
+    # cold-start histogram never sees the compile wall
+    wcoll = srv.colldb.get("_warmup")
+    wcoll.conf.result_cache_ttl = 0
+    wcoll.conf.pqr_enabled = False
+    docproc.index_document(wcoll, "http://tenants.test/_warmup",
+                           "<html><body><p>walrus warm</p></body>"
+                           "</html>")
+    for _ in range(3):
+        srv.handle("GET", "/search", {"q": "walrus", "c": "_warmup"},
+                   b"")
+    g_residency.reset()  # also parks _warmup; reset zeroes the knob...
+    g_residency.configure(max_resident=hot)  # ...so rearm the budget
+    g_stats.reset()
+
+    # --- leg 1: Zipf over the collection space ----------------------------
+    # the ONLY rng draw per query is the collection pick, so the LRU
+    # hit/cold trace is a pure function of (n_colls, hot, n_q, seed)
+    # and the gate threshold can be calibrated offline
+    rng = random.Random(23)
+    zipf_w = [1.0 / (r + 1) ** 1.5 for r in range(n_colls)]
+    idx = list(range(n_colls))
+    codes: Counter = Counter()
+    t_leg = time.monotonic()
+    for qi in range(n_q):
+        c = rng.choices(idx, weights=zipf_w, k=1)[0]
+        code, _, _ = srv.handle(
+            "GET", "/search",
+            {"q": words[qi % len(words)], "c": names[c]}, b"")
+        codes[code] += 1
+    leg_s = time.monotonic() - t_leg
+    counters = g_stats.snapshot()["counters"]
+    res = g_residency.snapshot()
+    hits = counters.get("tenancy.hit", 0)
+    colds = counters.get("tenancy.coldstart", 0)
+    hit_rate = hits / max(hits + colds, 1)
+    mem_rejects = sum(v for k, v in counters.items()
+                      if k.startswith("membudget.reject."))
+    sheds = (counters.get("admission.shed.refused", 0)
+             + counters.get("admission.shed.stale", 0))
+
+    # --- leg 2: weighted-fair quotas under a flood ------------------------
+    # a gate small enough to saturate from one process: the flood tenant
+    # must queue/shed against its OWN share while the trickle tenant
+    # passes untouched (collection = tenant on the serve path)
+    greedy, quiet = names[0], names[1]
+    srv.admission = adm.AdmissionGate(max_inflight=1, max_queue=4)
+    qcounts: Counter = Counter()
+    qlock = threading.Lock()
+    stop = threading.Event()
+
+    def flood() -> None:
+        while not stop.is_set():
+            try:
+                code, _, _ = srv.handle(
+                    "GET", "/search", {"q": "walrus", "c": greedy},
+                    b"")
+            except Exception:  # noqa: BLE001 — a lost reply is the bug
+                code = -1
+            with qlock:
+                qcounts[("greedy", code)] += 1
+
+    floggers = [threading.Thread(target=flood, daemon=True)
+                for _ in range(6)]
+    for th in floggers:
+        th.start()
+    time.sleep(0.1)  # let the flood saturate inflight + queue
+    for _ in range(25):
+        try:
+            code, _, _ = srv.handle(
+                "GET", "/search", {"q": "walrus", "c": quiet}, b"")
+        except Exception:  # noqa: BLE001
+            code = -1
+        with qlock:
+            qcounts[("quiet", code)] += 1
+        time.sleep(0.004)
+    stop.set()
+    for th in floggers:
+        th.join(timeout=10.0)
+    qcounters = g_stats.snapshot()["counters"]
+    quiet_shed = qcounts[("quiet", 503)] + qcounts[("quiet", -1)]
+    greedy_shed = qcounters.get(f"admission.tenant.{greedy}.shed", 0)
+    quota_sheds = qcounters.get("admission.shed.reason.quota", 0)
+
+    gates = {
+        "every_arrival_answered_200": (
+            sum(codes.values()) == n_q and codes.get(200, 0) == n_q),
+        "no_sheds_at_offered_load": sheds == 0,
+        "hot_set_hit_rate": hit_rate >= hit_gate,
+        "cold_path_exercised": colds > 0
+        and res["coldstarts"] == colds,
+        "coldstart_p99_bounded": 0 < res["coldstart_p99_ms"]
+        < cold_p99_ms,
+        "resident_within_budget": 0 < res["resident"] <= hot,
+        "zero_membudget_refusals": mem_rejects == 0,
+        "quiet_tenant_never_shed": (
+            quiet_shed == 0 and qcounts[("quiet", 200)] == 25),
+        "flood_tenant_shed_by_quota": greedy_shed > 0
+        and quota_sheds > 0,
+        "flood_sheds_all_counted": qcounts[("greedy", -1)] == 0,
+    }
+    ok = all(gates.values())
+    rep = {
+        "metric": "tenant_gate", "value": round(hit_rate, 3),
+        "unit": "residency_hit_rate", "ok": ok, "gates": gates,
+        "collections": n_colls, "hot_budget": hot, "queries": n_q,
+        "hits": hits, "cold_starts": colds,
+        "coldstart_p50_ms": res["coldstart_p50_ms"],
+        "coldstart_p99_ms": res["coldstart_p99_ms"],
+        "resident": res["resident"], "parked": res["parked"],
+        "device_bytes": res["device_bytes"],
+        "build_s": round(build_s, 2), "leg_s": round(leg_s, 2),
+        "qps": round(n_q / max(leg_s, 1e-9), 1),
+        "quota": {"greedy": {str(c): n for (t, c), n
+                             in sorted(qcounts.items()) if t == "greedy"},
+                  "quiet": {str(c): n for (t, c), n
+                            in sorted(qcounts.items()) if t == "quiet"},
+                  "greedy_shed": greedy_shed,
+                  "quota_sheds": quota_sheds},
+    }
+    rep.update(_backend_record())
+    print(json.dumps(rep))
+    srv.stop()
+    g_residency.reset()
+    shutil.rmtree(bdir, ignore_errors=True)
+    return rep
+
+
 if __name__ == "__main__":
     if os.environ.get("BENCH_SOAK"):
         sys.exit(0 if main_soak()["ok"] else 1)
@@ -2032,5 +2220,7 @@ if __name__ == "__main__":
         sys.exit(0 if main_load()["ok"] else 1)
     elif os.environ.get("BENCH_FLEET"):
         sys.exit(0 if main_fleet()["ok"] else 1)
+    elif os.environ.get("BENCH_TENANTS"):
+        sys.exit(0 if main_tenants()["ok"] else 1)
     else:
         main()

@@ -151,6 +151,19 @@ class Collection:
             db.dump()
         self._save_stats()
 
+    def close(self) -> None:
+        """Release process-wide accounting for a collection being
+        deleted or unloaded (the delColl half Collectiondb.cpp pairs
+        with addColl): zero every Rdb's memtable gauge — the budget
+        would otherwise bill a purged corpus forever — and drop the
+        host-side caches. Disk state is untouched; delete callers
+        rmtree separately."""
+        from ..utils.membudget import g_membudget
+        for db in self.rdbs().values():
+            g_membudget.set_gauge("memtable", str(db.dir), 0)
+        self.titlerec_cache.clear()
+        self.termlist_cache = TermlistCache()
+
 
 class CollectionDb:
     """Registry of collections (reference ``g_collectiondb``)."""
@@ -169,6 +182,17 @@ class CollectionDb:
                     raise KeyError(f"no such collection: {name}")
                 self.colls[name] = Collection(name, self.base_dir)
             return self.colls[name]
+
+    def drop(self, name: str) -> Collection | None:
+        """Unregister and ``close()`` a collection — the registry half
+        of delColl. The caller owns the directory's fate (and any
+        serve-layer residency teardown; this layer cannot import
+        serve)."""
+        with self._lock:
+            coll = self.colls.pop(name, None)
+        if coll is not None:
+            coll.close()
+        return coll
 
     def names(self) -> list[str]:
         disk = {p.name for p in (self.base_dir / "coll").glob("*") if p.is_dir()}

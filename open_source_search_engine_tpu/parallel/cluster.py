@@ -665,6 +665,11 @@ class ShardNodeServer:
                 if tier is not None:
                     g_stats.count(f"admission.node.{tier}")
                     nice = max(nice, priority_mod.tier_niceness(tier))
+                # the tenant rides the same way: re-bound so this
+                # node's accounting (and any further fan-out) bills
+                # the coordinator's ledger
+                tenant = priority_mod.tenant_from_header(
+                    self.headers.get(priority_mod.TENANT_HEADER))
                 accept_bin = BIN_CONTENT_TYPE in (
                     self.headers.get("Accept") or "")
                 # adopt an incoming trace context: run the handler
@@ -691,7 +696,8 @@ class ShardNodeServer:
                         payload = transport_mod.decode_body(
                             body, self.headers.get("Content-Type", ""))
                         with deadline_mod.bind(dl), \
-                                priority_mod.bind_tier(tier):
+                                priority_mod.bind_tier(tier), \
+                                priority_mod.bind_tenant(tenant):
                             if tr_hdr is not None:
                                 with trace_mod.g_tracer.adopt(
                                         tr_hdr[0], tr_hdr[1],
@@ -859,11 +865,12 @@ class _ShardSearchBatcher:
 
     def submit(self, q: str, topk: int, lang: int,
                timeout: float, parent_span=None,
-               deadline=None, tier=None) -> dict | None:
+               deadline=None, tier=None,
+               tenant=None) -> dict | None:
         holder = {"done": False, "out": None}
         with self._cv:
             self._queue.append(((topk, lang), q, holder, parent_span,
-                                deadline, tier))
+                                deadline, tier, tenant))
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threads.spawn(
                     f"shard{self.shard}-qbatch", self._run)
@@ -920,9 +927,15 @@ class _ShardSearchBatcher:
         tiers = [e[5] for e in batch if e[5] is not None]
         tier = (min(tiers, key=priority_mod.TIERS.index)
                 if tiers else None)
+        # riders of one coalesced leg share a coordinator/collection,
+        # so the first bound tenant speaks for the wave
+        tenants = [e[6] for e in batch
+                   if len(e) > 6 and e[6] is not None]
+        tenant = tenants[0] if tenants else None
         t0 = time.perf_counter()
         with trace_mod.attach(primary), deadline_mod.bind(dl), \
-                priority_mod.bind_tier(tier):
+                priority_mod.bind_tier(tier), \
+                priority_mod.bind_tenant(tenant):
             # span_parent rides along so the hedged read's per-attempt
             # spans (hedge fired/won) land in the primary rider's trace
             out = self.client._read_shard(
@@ -1340,7 +1353,8 @@ class ClusterClient:
 
     def _search_shard(self, shard: int, q: str, topk: int,
                       lang: int, parent_span=None,
-                      deadline=None, tier=None) -> dict | None:
+                      deadline=None, tier=None,
+                      tenant=None) -> dict | None:
         """One shard's leg of the scatter: rides the per-shard batcher
         so concurrent queries coalesce into one (hedged) RPC.
         ``parent_span`` carries the caller's trace across the
@@ -1361,7 +1375,8 @@ class ClusterClient:
                                            SEARCH_TIMEOUT_S,
                                            parent_span=parent_span,
                                            deadline=deadline,
-                                           tier=tier)
+                                           tier=tier,
+                                           tenant=tenant)
         if out is not None and out.get("ok", True):
             self._leg_cache.put(key, out, gen=gen)
         return out
@@ -1443,16 +1458,17 @@ class ClusterClient:
 
         want = max(topk + offset, PQR_SCAN)
         over = max(want * 2, 16)
-        # the scatter span (and the query deadline + tier) are handed
-        # to each leg explicitly: the legs run on read-pool threads,
-        # where contextvars do not follow
+        # the scatter span (and the query deadline + tier + tenant)
+        # are handed to each leg explicitly: the legs run on read-pool
+        # threads, where contextvars do not follow
         scatter_sp = trace_mod.begin("scatter",
                                      shards=self.conf.n_shards)
         dl = deadline_mod.current()
         tier = priority_mod.current_tier()
+        tenant = priority_mod.current_tenant()
         futs = [self._read_pool.submit(
             self._search_shard, s, q, over, lang, scatter_sp, dl,
-            tier)
+            tier, tenant)
             for s in range(self.conf.n_shards)]
         total = 0
         docids: list[int] = []

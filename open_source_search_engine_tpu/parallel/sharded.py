@@ -1099,8 +1099,12 @@ class MeshResident:
             # still correct, just time-shared)
             devices = [devices[s % len(devices)]
                        for s in range(sc.n_shards)]
-        from ..query.devindex import DeviceIndex
-        self.indexes = [DeviceIndex(sc.shards[s], device=devices[s])
+        # per-shard bases via the sanctioned factory (osselint
+        # residency-bypass): the mesh plane owns their lifecycle as a
+        # unit — MeshResident.stop(), not per-tenant LRU eviction
+        from ..query.engine import build_device_index
+        self.indexes = [build_device_index(sc.shards[s],
+                                           device=devices[s])
                         for s in range(sc.n_shards)]
         from concurrent.futures import ThreadPoolExecutor
         self._pool = ThreadPoolExecutor(max(sc.n_shards, 1))
@@ -1219,13 +1223,14 @@ class MeshResident:
         """The mesh ResidentLoop, spawned lazily (and respawned if
         stopped) — one ticket wave dispatches one mesh program across
         all chips."""
-        from ..query.resident import ResidentLoop
+        from ..query.engine import spawn_resident_loop
         loop = self._serve_loop
         if loop is not None and loop.alive:
             return loop
-        loop = ResidentLoop(self._serve_index,
-                            gen_fn=lambda: mesh_generation(self.sc),
-                            name=f"mesh-{self.sc.name}")
+        loop = spawn_resident_loop(
+            self._serve_index,
+            gen_fn=lambda: mesh_generation(self.sc),
+            name=f"mesh-{self.sc.name}")
         self._serve_loop = loop
         return loop
 

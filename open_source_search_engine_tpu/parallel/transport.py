@@ -422,6 +422,11 @@ class Transport:
             # leg, so node planes honor the tier too (crawlbot work
             # yields inside each host, not just at the coordinator)
             headers[priority_mod.PRIORITY_HEADER] = tier
+        tenant = priority_mod.current_tenant()
+        if tenant is not None:
+            # ...and its quota verdict: a node's gate bills the leg to
+            # the same tenant ledger the coordinator admitted against
+            headers[priority_mod.TENANT_HEADER] = tenant
         t0 = time.monotonic()
         for attempt in (0, 1):
             conn, reused = self._checkout(addr, timeout)
@@ -546,6 +551,7 @@ class Transport:
             trace_mod.current_span()
         dl = deadline_mod.current()
         tier = priority_mod.current_tier()
+        tenant = priority_mod.current_tenant()
         deadline = deadline_mod.Deadline.after(timeout)
         if dl is not None and dl.at < deadline.at:
             deadline = dl  # the query budget runs out first
@@ -563,8 +569,11 @@ class Transport:
                 # with the plain 5-arg signature
                 kw = {} if spans[i] is None else {"span": spans[i]}
                 # launch threads start with empty contextvars: re-bind
-                # the caller's deadline AND tier so both ride the wire
-                with deadline_mod.bind(dl), priority_mod.bind_tier(tier):
+                # the caller's deadline, tier AND tenant so all three
+                # ride the wire
+                with deadline_mod.bind(dl), \
+                        priority_mod.bind_tier(tier), \
+                        priority_mod.bind_tenant(tenant):
                     out = self.request(addrs[i], path, payload,
                                        timeout=timeout,
                                        niceness=niceness, **kw)

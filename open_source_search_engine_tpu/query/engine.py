@@ -415,24 +415,30 @@ def get_device_index(coll: Collection):
     return di
 
 
-def get_resident_loop(coll: Collection):
-    """The collection's ResidentLoop (query/resident.py), created
-    lazily like the device index itself. The loop owns issue/collect
-    sequencing; everything host-side (results building, snippets)
-    stays on the caller's thread."""
+def get_resident_loop(coll: Collection, deadline=None):
+    """The collection's ResidentLoop — owned by the tenant plane's
+    :class:`~..serve.tenancy.ResidencyManager` (LRU hot set, parked
+    cold tenants, single-flight cold start). The lazy import mirrors
+    get_mesh_resident's: the serve layer imports this module at load,
+    so the reverse edge resolves at call time only."""
+    from ..serve.tenancy import g_residency
+    return g_residency.loop_for(coll, deadline=deadline)
+
+
+def build_device_index(coll, device=None):
+    """Sanctioned DeviceIndex factory for the planes that legitimately
+    own per-shard bases (the mesh plane's MeshServeIndex). Everything
+    else goes through the residency manager — the osselint
+    ``residency-bypass`` rule fences direct construction into
+    serve/tenancy.py and this module."""
+    from .devindex import DeviceIndex
+    return DeviceIndex(coll, device=device)
+
+
+def spawn_resident_loop(di_fn, gen_fn, **kw):
+    """Sanctioned ResidentLoop factory (see build_device_index)."""
     from .resident import ResidentLoop
-    loop = getattr(coll, "_resident_loop", None)
-    if loop is not None and loop.alive:
-        return loop
-    with _DI_CREATE_LOCK:
-        loop = getattr(coll, "_resident_loop", None)
-        if loop is None or not loop.alive:
-            loop = ResidentLoop(
-                lambda: get_device_index(coll),
-                gen_fn=lambda: coll.posdb.version,
-                name=getattr(coll, "name", "coll"))
-            coll._resident_loop = loop
-    return loop
+    return ResidentLoop(di_fn, gen_fn=gen_fn, **kw)
 
 
 def get_mesh_resident(sc):
@@ -479,7 +485,7 @@ def search_device_batch(coll: Collection, queries, *, topk: int = 10,
         raise deadline_mod.DeadlineExceeded(
             "deadline exceeded before device dispatch")
     if resident:
-        loop = get_resident_loop(coll)
+        loop = get_resident_loop(coll, deadline=deadline_mod.current())
         with trace.timed_span("query.device_batch", queries=len(plans),
                               topk=ktot, resident=True):
             ticket = loop.submit(plans, topk=ktot, lang=lang,

@@ -21,7 +21,13 @@ Shed triggers, in the order they are consulted:
 3. the *predicted* queue delay (waiters ahead x EWMA service time)
    would eat the request's deadline — shedding now is strictly cheaper
    than timing out later (the metastable-collapse preventer: work that
-   cannot finish in time never enters the queue).
+   cannot finish in time never enters the queue);
+4. the tenant is over its weighted-fair queue share while other
+   tenants are active (``quota``) — the crawlbot-API story: one
+   aggressive customer must not starve the others. Shares borrow when
+   idle (a lone tenant may use the whole gate), and a full queue owes
+   an under-share tenant room: the arrival displaces the newest waiter
+   of an over-share tenant instead of shedding.
 
 A gate can also be **drained** (``drain()``): every new arrival sheds
 with reason ``draining`` while admitted work runs to completion —
@@ -51,7 +57,7 @@ from ..utils.stats import g_stats
 
 class Shed(RuntimeError):
     """The gate refused this request. ``reason`` names the trigger
-    (``queue_full``/``signal``/``deadline``/``timeout``);
+    (``queue_full``/``signal``/``deadline``/``timeout``/``quota``);
     ``retry_after_s`` is the Retry-After hint for the 503 path."""
 
     def __init__(self, reason: str, retry_after_s: float = 1.0):
@@ -64,10 +70,11 @@ class _Admitted:
     """The held slot; a context manager so the release (and the
     service-time EWMA feeding the delay predictor) can't be skipped."""
 
-    __slots__ = ("_gate", "_t0")
+    __slots__ = ("_gate", "_t0", "tenant")
 
-    def __init__(self, gate: "AdmissionGate"):
+    def __init__(self, gate: "AdmissionGate", tenant: str | None = None):
         self._gate = gate
+        self.tenant = tenant
         self._t0 = time.monotonic()
 
     def __enter__(self) -> "_Admitted":
@@ -76,7 +83,8 @@ class _Admitted:
     def __exit__(self, *exc) -> None:
         # monotonic delta = budget arithmetic for the predictor, not a
         # reported latency (those ride trace.record below)
-        self._gate._release(time.monotonic() - self._t0)
+        self._gate._release(time.monotonic() - self._t0,
+                            tenant=self.tenant)
 
 
 class AdmissionGate:
@@ -109,6 +117,13 @@ class AdmissionGate:
         self._svc_s = 0.020
         self.admitted_total = 0
         self.shed_total = 0
+        #: the tier × tenant weighted-fair ledger; callers passing
+        #: ``tenant=None`` bypass it entirely (legacy behavior)
+        self._t_weight: dict[str, float] = {}
+        self._t_inflight: dict[str, int] = {}
+        self._t_queued: dict[str, int] = {}
+        self._t_served: dict[str, int] = {}
+        self._t_shed: dict[str, int] = {}
 
     @staticmethod
     def _mem_pressure() -> bool:
@@ -119,9 +134,14 @@ class AdmissionGate:
 
     # --- admission --------------------------------------------------------
 
-    def admit(self, tier: str, deadline=None) -> _Admitted:
+    def admit(self, tier: str, deadline=None,
+              tenant: str | None = None) -> _Admitted:
         """Admit or raise :class:`Shed`. Blocks (bounded by the
-        request deadline and ``max_wait_s``) while the gate is full."""
+        request deadline and ``max_wait_s``) while the gate is full.
+        ``tenant`` opts the request into the weighted-fair ledger: a
+        tenant over its queue share sheds with reason ``quota`` while
+        other tenants contend (shares borrow when idle, so a lone
+        tenant may use the whole gate)."""
         if tier not in TIERS:
             tier = "interactive"
         t_enq = time.perf_counter()
@@ -130,29 +150,101 @@ class AdmissionGate:
                 # draining gates shed unconditionally — cheaper for the
                 # caller to hedge to the twin than to queue behind a
                 # node that is about to checkpoint and exit
-                raise self._shed_locked(tier, "draining")
+                raise self._shed_locked(tier, "draining", tenant)
+            if tenant is not None:
+                self._t_weight.setdefault(tenant, 1.0)
             n_wait = sum(len(q) for q in self._waiting.values())
             if n_wait >= self.max_queue:
-                g_stats.count("admission.queue_full")
-                raise self._shed_locked(tier, "queue_full")
+                # a full queue still owes an under-share tenant room:
+                # displace an over-share tenant's newest waiter rather
+                # than shed the quiet arrival (the fairness half of
+                # queue_full)
+                if tenant is None or not self._displace_locked(tenant):
+                    g_stats.count("admission.queue_full")
+                    raise self._shed_locked(tier, "queue_full", tenant)
             if tier != "interactive" and \
                     (self._degraded_fn() or self._pressure_fn()):
                 # the cheap early shed: while the error budget burns or
                 # memory headroom is gone, background tiers never enter
-                raise self._shed_locked(tier, "signal")
+                raise self._shed_locked(tier, "signal", tenant)
             est = self._est_wait_locked(tier)
             if deadline is not None and (
                     deadline.expired() or est > deadline.remaining()):
-                raise self._shed_locked(tier, "deadline")
+                raise self._shed_locked(tier, "deadline", tenant)
             if self._inflight < self.max_inflight and \
                     not self._ahead_locked(tier):
                 self._inflight += 1
                 self.admitted_total += 1
+                if tenant is not None:
+                    self._t_inflight[tenant] = \
+                        self._t_inflight.get(tenant, 0) + 1
             else:
-                self._wait_locked(tier, deadline)
+                # the queue path is where shares bind: an over-share
+                # tenant sheds at the door instead of eating a slot a
+                # quieter tenant is owed
+                if tenant is not None and \
+                        self._t_queued.get(tenant, 0) + 1 > \
+                        self._share_locked(tenant):
+                    raise self._shed_locked(tier, "quota", tenant)
+                self._wait_locked(tier, deadline, tenant)
+            if tenant is not None:
+                self._t_served[tenant] = \
+                    self._t_served.get(tenant, 0) + 1
         g_stats.count("admission.admitted")
+        if tenant is not None:
+            g_stats.count(f"admission.tenant.{tenant}.served")
         trace_mod.record("admission.queue_delay", t_enq)
-        return _Admitted(self)
+        return _Admitted(self, tenant)
+
+    def set_tenant_weight(self, tenant: str, weight: float) -> None:
+        """Relative fair-share weight (default 1.0; <= 0 resets)."""
+        with self._cv:
+            self._t_weight[tenant] = \
+                float(weight) if weight > 0 else 1.0
+
+    def _share_locked(self, tenant: str,
+                      extra: str | None = None) -> float:
+        """The tenant's fair queue share: ``max_queue`` split by
+        weight across *active* tenants (inflight or queued, plus the
+        arrival). Idle tenants donate their share — a lone tenant gets
+        the whole queue; everyone is owed at least one slot. ``extra``
+        counts a not-yet-queued arrival as active (displacement asks
+        for the VICTIM's share as it will be once the arrival joins)."""
+        active = {t for t, n in self._t_inflight.items() if n > 0}
+        active.update(t for t, n in self._t_queued.items() if n > 0)
+        active.add(tenant)
+        if extra is not None:
+            active.add(extra)
+        if len(active) <= 1:
+            return float("inf")
+        total_w = sum(self._t_weight.get(t, 1.0) for t in active)
+        return max(self.max_queue
+                   * self._t_weight.get(tenant, 1.0)
+                   / max(total_w, 1e-9), 1.0)
+
+    def _displace_locked(self, tenant: str) -> bool:
+        """queue_full fairness: when the arriving tenant is under its
+        share, evict an over-share tenant's newest waiter (lowest tier
+        first) with reason ``quota`` to make room. False leaves the
+        arrival to shed ``queue_full`` itself."""
+        if self._t_queued.get(tenant, 0) + 1 > \
+                self._share_locked(tenant):
+            return False
+        for t in reversed(TIERS):
+            q = self._waiting[t]
+            for i in range(len(q) - 1, -1, -1):
+                victim = q[i]
+                vt = victim.get("tenant")
+                if vt is None or vt == tenant:
+                    continue
+                if self._t_queued.get(vt, 0) > \
+                        self._share_locked(vt, extra=tenant):
+                    del q[i]
+                    self._t_queued[vt] = self._t_queued.get(vt, 1) - 1
+                    victim["shed"] = "quota"
+                    self._cv.notify_all()  # its thread raises on wake
+                    return True
+        return False
 
     def _ahead_locked(self, tier: str) -> bool:
         """Any waiter at the same or higher priority? (FIFO within a
@@ -178,38 +270,59 @@ class AdmissionGate:
             return 0.0
         return (backlog / max(self.max_inflight, 1)) * self._svc_s
 
-    def _wait_locked(self, tier: str, deadline) -> None:
-        w = {"go": False}
+    def _wait_locked(self, tier: str, deadline,
+                     tenant: str | None = None) -> None:
+        w = {"go": False, "tenant": tenant, "shed": None}
         self._waiting[tier].append(w)
+        if tenant is not None:
+            self._t_queued[tenant] = self._t_queued.get(tenant, 0) + 1
         g_stats.count("admission.queued")
         budget = deadline_mod.Deadline.after(self.max_wait_s)
         if deadline is not None and deadline.at < budget.at:
             budget = deadline
-        while not w["go"] and not self._draining:
+        while not w["go"] and w["shed"] is None and not self._draining:
             left = budget.remaining()
             if left <= 0:
                 break
             self._cv.wait(left)
+        if w["shed"] is not None:
+            # displaced by an under-share arrival; the displacer
+            # already removed us from the queue and the ledger
+            raise self._shed_locked(tier, w["shed"], tenant)
         if not w["go"]:
             # grant pops under this lock, so un-granted => still queued
             self._waiting[tier].remove(w)
+            if tenant is not None:
+                self._t_queued[tenant] = \
+                    self._t_queued.get(tenant, 1) - 1
             if self._draining:
-                raise self._shed_locked(tier, "draining")
+                raise self._shed_locked(tier, "draining", tenant)
             raise self._shed_locked(
                 tier, "deadline" if deadline is not None
-                and deadline.expired() else "timeout")
+                and deadline.expired() else "timeout", tenant)
         self.admitted_total += 1  # _grant_locked took the slot for us
 
-    def _shed_locked(self, tier: str, reason: str) -> Shed:
+    def _shed_locked(self, tier: str, reason: str,
+                     tenant: str | None = None) -> Shed:
         self.shed_total += 1
         g_stats.count(f"admission.shed.reason.{reason}")
+        if tenant is not None:
+            self._t_shed[tenant] = self._t_shed.get(tenant, 0) + 1
+            g_stats.count(f"admission.tenant.{tenant}.shed")
         retry = max(self._est_wait_locked(tier), self._svc_s)
         return Shed(reason, retry_after_s=retry)
 
-    def _release(self, service_s: float) -> None:
+    def _release(self, service_s: float,
+                 tenant: str | None = None) -> None:
         with self._cv:
             self._svc_s += 0.2 * (max(service_s, 0.0) - self._svc_s)
             self._inflight -= 1
+            if tenant is not None:
+                n = self._t_inflight.get(tenant, 1) - 1
+                if n <= 0:
+                    self._t_inflight.pop(tenant, None)
+                else:
+                    self._t_inflight[tenant] = n
             self._grant_locked()
             self._cv.notify_all()
 
@@ -217,13 +330,32 @@ class AdmissionGate:
         while self._inflight < self.max_inflight:
             w = None
             for t in TIERS:
-                if self._waiting[t]:
-                    w = self._waiting[t].popleft()
-                    break
+                q = self._waiting[t]
+                if not q:
+                    continue
+                # weighted-fair within the tier: wake the waiter whose
+                # tenant holds the least inflight per unit weight
+                # (strict < keeps FIFO on ties, and all-legacy queues
+                # — tenant None, load 0 — degenerate to pure FIFO)
+                best_i, best = 0, None
+                for i, cand in enumerate(q):
+                    ct = cand.get("tenant")
+                    load = 0.0 if ct is None else (
+                        self._t_inflight.get(ct, 0)
+                        / self._t_weight.get(ct, 1.0))
+                    if best is None or load < best:
+                        best, best_i = load, i
+                w = q[best_i]
+                del q[best_i]
+                break
             if w is None:
                 return
             w["go"] = True
             self._inflight += 1
+            wt = w.get("tenant")
+            if wt is not None:
+                self._t_queued[wt] = self._t_queued.get(wt, 1) - 1
+                self._t_inflight[wt] = self._t_inflight.get(wt, 0) + 1
 
     # --- drain (rolling-restart sequencing) -------------------------------
 
@@ -273,6 +405,18 @@ class AdmissionGate:
                 "svc_ewma_ms": round(self._svc_s * 1000.0, 3),
                 "admitted_total": self.admitted_total,
                 "shed_total": self.shed_total,
+                "tenants": {
+                    t: {
+                        "weight": self._t_weight.get(t, 1.0),
+                        "inflight": self._t_inflight.get(t, 0),
+                        "queued": self._t_queued.get(t, 0),
+                        "served": self._t_served.get(t, 0),
+                        "shed": self._t_shed.get(t, 0),
+                    }
+                    for t in sorted(set(self._t_weight)
+                                    | set(self._t_served)
+                                    | set(self._t_shed))
+                },
             }
 
     def idle(self) -> bool:

@@ -140,11 +140,19 @@ class CrawlBot:
         job.done = True
         if job.thread is not None:
             job.thread.join(5.0)  # let the loop notice before purging
+        cname = f"crawl_{name}"
         try:
-            coll = self.colldb.colls.pop(f"crawl_{name}", None)
+            # delColl must unserve before it purges: stop the resident
+            # loop and release the device gauge (serve.tenancy), then
+            # zero the membudget accounting (Collection.close) — a
+            # deleted corpus must not keep answering from HBM or keep
+            # billing the budget
+            from .tenancy import g_residency
+            g_residency.release(cname)
+            coll = self.colldb.drop(cname)
             cdir = coll.dir if coll is not None else None
             if cdir is None:
-                base = self.colldb.base_dir / "coll" / f"crawl_{name}"
+                base = self.colldb.base_dir / "coll" / cname
                 cdir = base if base.exists() else None
             if cdir is not None:
                 shutil.rmtree(cdir, ignore_errors=True)

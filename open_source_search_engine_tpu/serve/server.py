@@ -120,7 +120,8 @@ class QueryBatcher:
                     "query batcher queue full")
             self._queue.append((key, q, holder,
                                 trace_mod.current_span(), dl,
-                                priority_mod.current_tier()))
+                                priority_mod.current_tier(),
+                                priority_mod.current_tenant()))
             self._gauge_locked()
             self._cv.notify_all()
             while "res" not in holder and "err" not in holder:
@@ -205,9 +206,17 @@ class QueryBatcher:
                      if len(e) > 5 and e[5] is not None]
             tier = (min(tiers, key=priority_mod.TIERS.index)
                     if tiers else None)
+            # riders of one wave share a key => share a collection =>
+            # share a tenant; carry the first one forward so the wave
+            # bills (and sheds) against the right quota downstream
+            tenants = [e[6] for e in batch
+                       if len(e) > 6 and e[6] is not None]
+            tenant = tenants[0] if tenants else None
             t0 = time.perf_counter()
             with trace_mod.attach(parents[0] if parents else None), \
-                    deadline_mod.bind(dl), priority_mod.bind_tier(tier):
+                    deadline_mod.bind(dl), \
+                    priority_mod.bind_tier(tier), \
+                    priority_mod.bind_tenant(tenant):
                 res = self._run_batch(key, [e[1] for e in batch])
             for p in parents[1:]:
                 p.record("query.device_batch", t0, coalesced=True,
@@ -336,6 +345,17 @@ class SearchHTTPServer:
         #: dispatch planes — sheds stale-or-503 before the membudget
         #: ever has to refuse real work (serve/admission.py)
         self.admission = admission_mod.AdmissionGate()
+        #: tenant plane: the residency manager owns every collection's
+        #: (DeviceIndex, ResidentLoop) lifecycle; its hot-set count
+        #: rides the tenant_hot parm, its byte bound the membudget
+        #: "device" label cap (device_budget parm, 0 = uncapped)
+        from .tenancy import g_residency
+        g_residency.configure(
+            max_resident=int(getattr(self.conf, "tenant_hot", 0)))
+        g_residency.attach(g_membudget)
+        if int(getattr(self.conf, "device_budget", 0)) > 0:
+            g_membudget.set_label_cap(
+                "device", int(self.conf.device_budget))
         #: statsdb persistence (reference Statsdb: an on-disk ring of
         #: timestamped metric samples behind PagePerf graphs)
         self._statsdb_path = Path(base_dir) / "statsdb.jsonl"
@@ -380,6 +400,11 @@ class SearchHTTPServer:
             g_tracer.configure(sample_n=int(value))
         elif name == "slow_query_ms":
             g_tracer.configure(slow_ms=float(value))
+        elif name == "tenant_hot":
+            from .tenancy import g_residency
+            g_residency.configure(max_resident=int(value))
+        elif name == "device_budget":
+            g_membudget.set_label_cap("device", int(value))
 
     BAN_COOLDOWN_S = 60.0
 
@@ -454,12 +479,14 @@ class SearchHTTPServer:
     def handle(self, method: str, path: str, query: dict,
                body: bytes, client_ip: str = "",
                niceness: int = 0,
-               tier: str | None = None) -> tuple[int, str, str]:
+               tier: str | None = None,
+               tenant: str | None = None) -> tuple[int, str, str]:
         """Route one request → (status, payload, content_type).
         The Pages.cpp s_pages[] table, as a method. Background
         (niceness-1) requests yield to in-flight interactive ones
-        (UdpProtocol.h niceness bit). ``tier`` is a propagated
-        X-OSSE-Priority verdict, if the caller carried one."""
+        (UdpProtocol.h niceness bit). ``tier``/``tenant`` are
+        propagated X-OSSE-Priority / X-OSSE-Tenant verdicts, if the
+        caller carried them."""
         # drop any extra response headers a previous request left on
         # this thread's context (direct handle() callers never pop)
         admission_mod.pop_response_headers()
@@ -467,14 +494,16 @@ class SearchHTTPServer:
         try:
             return self._handle_inner(method, path, query, body,
                                       client_ip, niceness=niceness,
-                                      header_tier=tier)
+                                      header_tier=tier,
+                                      header_tenant=tenant)
         finally:
             self.nice_gate.exit(niceness)
 
     def _handle_inner(self, method: str, path: str, query: dict,
                       body: bytes, client_ip: str = "",
                       niceness: int = 0,
-                      header_tier: str | None = None
+                      header_tier: str | None = None,
+                      header_tenant: str | None = None
                       ) -> tuple[int, str, str]:
         try:
             if path == "/":
@@ -505,11 +534,17 @@ class SearchHTTPServer:
                 tier = priority_mod.classify(query, niceness=niceness,
                                              header_tier=header_tier)
                 g_stats.count(f"admission.tier.{tier}")
+                # the billing tenant IS the collection (the crawlbot
+                # customer); a propagated header keeps a scatter leg
+                # on its coordinator's quota ledger
+                tenant = header_tenant or query.get("c", "main")
                 # NOT under the global lock: the micro-batcher would
                 # deadlock (its worker takes the lock), and holding it
                 # per-request caps the plane at 1/latency qps
-                with priority_mod.bind_tier(tier):
-                    return self._page_search(query, tier=tier)
+                with priority_mod.bind_tier(tier), \
+                        priority_mod.bind_tenant(tenant):
+                    return self._page_search(query, tier=tier,
+                                             tenant=tenant)
             with self._lock:
                 return self._route(method, path, query, body)
         except Exception as e:  # noqa: BLE001 — server must not die
@@ -593,6 +628,8 @@ class SearchHTTPServer:
             return self._page_jit(query)
         if path == "/admin/admission":
             return self._page_admission(query)
+        if path == "/admin/tenants":
+            return self._page_tenants(query)
         return 404, json.dumps({"error": "no such page"}), \
             "application/json"
 
@@ -617,7 +654,8 @@ class SearchHTTPServer:
                 "</form></body></html>")
 
     def _page_search(self, query: dict,
-                     tier: str = "interactive") -> tuple[int, str, str]:
+                     tier: str = "interactive",
+                     tenant: str | None = None) -> tuple[int, str, str]:
         q = query.get("q", "")
         if not q:
             return 400, json.dumps({"error": "missing q"}), \
@@ -634,7 +672,8 @@ class SearchHTTPServer:
             with trace_mod.timed_span("serve.search"), \
                     trace_mod.timed_span(f"serve.search.{tier}"):
                 out = self._page_search_traced(query, q, debug, tr,
-                                               tier=tier)
+                                               tier=tier,
+                                               tenant=tenant)
         return out
 
     def _query_deadline(self, query: dict):
@@ -651,7 +690,8 @@ class SearchHTTPServer:
         return deadline_mod.Deadline.after(ms / 1000.0)
 
     def _page_search_traced(self, query: dict, q: str, debug: bool,
-                            tr, tier: str = "interactive"
+                            tr, tier: str = "interactive",
+                            tenant: str | None = None
                             ) -> tuple[int, str, str]:
         n = min(int(query.get("n", 10)), 100)
         # deep paging: first result number (reference PageResults s=),
@@ -685,7 +725,8 @@ class SearchHTTPServer:
                 trace_mod.tag(result_cache="hit")
                 return page
         try:
-            token = self.admission.admit(tier, deadline=dl)
+            token = self.admission.admit(tier, deadline=dl,
+                                         tenant=tenant)
         except admission_mod.Shed as shed:
             return self._shed_response(shed, ckey, gen)
         try:
@@ -1021,7 +1062,7 @@ class SearchHTTPServer:
             f'<li><a href="/admin/{p}{sfx}">{p}</a></li>'
             for p in ("stats", "hosts", "perf", "mem", "transport",
                       "cache", "traces", "parms", "jit", "admission",
-                      "profiler",
+                      "tenants", "profiler",
                       "graph")) + '<li><a href="/metrics">metrics</a></li>'
         rows = "".join(f"<tr><td>{k}</td><td>{v}</td></tr>"
                        for k, v in self.stats.items())
@@ -1061,6 +1102,60 @@ class SearchHTTPServer:
             f"{qrows}</table>"
             f"<h2>queue delay</h2><p>{json.dumps(qd)}</p>"
             f"<h2>counters</h2><table border=1>{crows}</table>"
+            "</body></html>"), "text/html"
+
+    def _page_tenants(self, query: dict) -> tuple[int, str, str]:
+        """Tenant-plane view: the resident set with LRU/pin state and
+        device bytes (ResidencyManager), cold-start p50/p99, and each
+        tenant's admission ledger — weight, share counters, served vs
+        shed (the per-tenant SLO burn proxy: shed/(served+shed)).
+        ``?format=json`` returns the raw snapshots."""
+        from .tenancy import g_residency
+        res = g_residency.snapshot()
+        adm = self.admission.snapshot().get("tenants", {})
+        if query.get("format") == "json":
+            return 200, json.dumps(
+                {"residency": res, "admission": adm}), \
+                "application/json"
+        names = sorted(set(res["tenants"]) | set(adm))
+        rows = []
+        for n in names:
+            rt = res["tenants"].get(n, {})
+            at = adm.get(n, {})
+            served = at.get("served", 0)
+            shed = at.get("shed", 0)
+            burn = shed / (served + shed) if served + shed else 0.0
+            rows.append(
+                f"<tr><td>{html_mod.escape(n)}</td>"
+                f"<td>{'RESIDENT' if rt.get('resident') else 'parked'}"
+                f"{' (pinned)' if rt.get('pinned') else ''}</td>"
+                f"<td>{rt.get('device_bytes', 0) / (1 << 20):.2f}</td>"
+                f"<td>{rt.get('hits', 0)}</td>"
+                f"<td>{rt.get('cold_starts', 0)}</td>"
+                f"<td>{at.get('weight', 1.0):g}</td>"
+                f"<td>{at.get('inflight', 0)}</td>"
+                f"<td>{at.get('queued', 0)}</td>"
+                f"<td>{served}</td><td>{shed}</td>"
+                f"<td>{100.0 * burn:.1f}%</td></tr>")
+        table = "".join(rows) or "<tr><td colspan=11>no tenants</td></tr>"
+        return 200, (
+            "<html><head><title>gb tenants</title></head><body>"
+            "<h1>tenant plane</h1>"
+            f"<p>resident {res['resident']}"
+            + (f"/{res['max_resident']}" if res['max_resident'] else "")
+            + f" &middot; parked {res['parked']}"
+            f" &middot; device "
+            f"{res['device_bytes'] / (1 << 20):.1f} MB"
+            + (f" (cap {res['device_cap'] / (1 << 20):.1f} MB)"
+               if res['device_cap'] else "")
+            + f" &middot; cold starts {res['coldstarts']}"
+            f" (p50 {res['coldstart_p50_ms']:.1f} ms, "
+            f"p99 {res['coldstart_p99_ms']:.1f} ms)</p>"
+            "<table border=1><tr><th>tenant</th><th>state</th>"
+            "<th>device MB</th><th>hits</th><th>cold starts</th>"
+            "<th>weight</th><th>inflight</th><th>queued</th>"
+            "<th>served</th><th>shed</th><th>shed rate</th></tr>"
+            f"{table}</table>"
             "</body></html>"), "text/html"
 
     def _page_mem(self, query: dict) -> tuple[int, str, str]:
@@ -1267,6 +1362,18 @@ class SearchHTTPServer:
                          f"{st.total_ms:g}")
             lines.append(f'osse_latency_ms_count{{name="{name}"}} '
                          f"{st.count}")
+        # per-tenant request outcomes as proper labels (the quota
+        # plane's scrape surface), parsed back out of the dotted
+        # admission.tenant.<t>.<outcome> counter namespace
+        lines.append("# TYPE osse_tenant_requests_total counter")
+        for k, v in sorted(fleet["counters"].items()):
+            if not k.startswith("admission.tenant."):
+                continue
+            t, _, outcome = k[len("admission.tenant."):].rpartition(".")
+            if t and outcome in ("served", "shed"):
+                lines.append(
+                    f'osse_tenant_requests_total{{tenant="{t}",'
+                    f'outcome="{outcome}"}} {v}')
         lines.append("# TYPE osse_counter counter")
         lines.extend(f'osse_counter{{name="{k}"}} {v}'
                      for k, v in sorted(fleet["counters"].items()))
@@ -1669,13 +1776,16 @@ class SearchHTTPServer:
                     nice = int(self.headers.get("X-Niceness") or 0)
                 except ValueError:
                     nice = 0
-                # a scatter leg carries its coordinator's tier verdict
+                # a scatter leg carries its coordinator's tier and
+                # tenant verdicts
                 tier = priority_mod.tier_from_header(
                     self.headers.get(priority_mod.PRIORITY_HEADER))
+                tenant = priority_mod.tenant_from_header(
+                    self.headers.get(priority_mod.TENANT_HEADER))
                 status, payload, ctype = outer.handle(
                     method, parsed.path, query, body,
                     client_ip=self.client_address[0], niceness=nice,
-                    tier=tier)
+                    tier=tier, tenant=tenant)
                 data = payload.encode("utf-8")
                 self.send_response(status)
                 self.send_header("Content-Type", ctype + "; charset=utf-8")
@@ -1725,12 +1835,11 @@ class SearchHTTPServer:
     def stop(self) -> None:
         self._stop_sampling.set()
         self._batcher.stop()
-        # stop per-collection resident loops with the batcher that fed
-        # them (engine.get_resident_loop lazily respawns on restart)
-        for cn in self.colldb.names():
-            loop = getattr(self.colldb.get(cn), "_resident_loop", None)
-            if loop is not None:
-                loop.stop()
+        # park every resident tenant with the batcher that fed it (the
+        # residency manager keeps the records, so a start()/stop()
+        # cycle cold-starts cleanly from the devcache base)
+        from .tenancy import g_residency
+        g_residency.stop_all()
         if self.sharded is not None:
             # mesh serving plane: stop its loop too (lazily respawned
             # by MeshResident.serve_loop on restart)

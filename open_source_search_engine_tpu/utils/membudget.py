@@ -45,8 +45,9 @@ log = get_logger("membudget")
 DEFAULT_LIMIT = 4 << 30
 
 #: the per-subsystem labels the core planes report under (free-form
-#: strings are accepted; these are the wired ones)
-LABELS = ("memtable", "merge", "pack", "docproc", "cache")
+#: strings are accepted; these are the wired ones). "device" is the
+#: tenant plane's HBM-resident index bytes (serve/tenancy.py).
+LABELS = ("memtable", "merge", "pack", "docproc", "cache", "device")
 
 
 class MemBudget:
@@ -59,11 +60,23 @@ class MemBudget:
         self._reserved: dict[str, int] = {}
         #: label -> {owner key -> bytes} (long-lived gauges)
         self._gauges: dict[str, dict[object, int]] = {}
+        #: label -> soft cap in bytes (set_label_cap); breaching a cap
+        #: runs the pressure pass scoped to that label rather than
+        #: refusing — the "device" cap bounds the resident tenant set
+        #: independently of the global limit
+        self._caps: dict[str, int] = {}
         #: label -> refusal count (mirrors the g_stats counters)
         self.rejections: dict[str, int] = {}
         self.high_water = 0
-        #: weakly-held callables ``fn(need_bytes) -> freed_bytes_hint``
-        self._pressure: list[object] = []
+        #: (priority, seq, key, weak fn) — run ascending by priority
+        #: until the budget fits, so cheap shedders (cold tenants) go
+        #: before expensive ones (the cache plane)
+        self._pressure: list[tuple] = []
+        self._pressure_seq = 0
+        #: labels with a cap-relief pass in flight (a handler that
+        #: zeroes gauges re-enters set_gauge; the guard stops the
+        #: recursion, not the relief)
+        self._relieving: set[str] = set()
 
     # --- limit -----------------------------------------------------------
 
@@ -71,6 +84,24 @@ class MemBudget:
         """Re-point the budget (the max_mem parm live-update hook)."""
         with self._lock:
             self.limit = max(int(limit), 1)
+
+    def set_label_cap(self, label: str, nbytes: int) -> None:
+        """Soft cap for ONE label, independent of the global limit
+        (0/negative clears). Breaching it triggers a label-scoped
+        pressure pass (``membudget.cap_evict``) instead of a refusal —
+        the device label's cap is how the tenant plane sizes its hot
+        set."""
+        with self._lock:
+            if int(nbytes) <= 0:
+                self._caps.pop(label, None)
+                return
+            self._caps[label] = int(nbytes)
+        g_stats.gauge(f"membudget.cap.{label}", int(nbytes))
+
+    def label_cap(self, label: str) -> int:
+        """The label's soft cap, 0 = uncapped."""
+        with self._lock:
+            return self._caps.get(label, 0)
 
     # --- accounting ------------------------------------------------------
 
@@ -95,7 +126,9 @@ class MemBudget:
 
     def set_gauge(self, label: str, key: object, nbytes: int) -> None:
         """Absolute usage of one owner under a label (0 removes it).
-        ``key`` is any hashable owner identity (an Rdb's dir path)."""
+        ``key`` is any hashable owner identity (an Rdb's dir path).
+        Pushing a capped label over its soft cap runs the label-scoped
+        pressure pass (counted ``membudget.cap_evict``)."""
         with self._lock:
             g = self._gauges.setdefault(label, {})
             if nbytes <= 0:
@@ -103,6 +136,29 @@ class MemBudget:
             else:
                 g[key] = int(nbytes)
             self.high_water = max(self.high_water, self._used_locked())
+            cap = self._caps.get(label, 0)
+            over = (cap > 0 and label not in self._relieving
+                    and self._label_used_locked(label) > cap)
+            if over:
+                self._relieving.add(label)
+        if over:
+            try:
+                g_stats.count("membudget.cap_evict")
+                g_stats.count(f"membudget.cap_evict.{label}")
+                with self._lock:
+                    excess = self._label_used_locked(label) - cap
+                self._relieve(max(excess, 1), label=label)
+            finally:
+                with self._lock:
+                    self._relieving.discard(label)
+
+    def _label_used_locked(self, label: str) -> int:
+        return (self._reserved.get(label, 0)
+                + sum(self._gauges.get(label, {}).values()))
+
+    def _label_fits_locked(self, label: str) -> bool:
+        cap = self._caps.get(label, 0)
+        return cap <= 0 or self._label_used_locked(label) <= cap
 
     def reserve(self, label: str, nbytes: int) -> bool:
         """Claim ``nbytes`` under ``label``; False = over budget (after
@@ -117,12 +173,22 @@ class MemBudget:
             # forced pressure: the shed-before-refuse path must run
             # even when the budget would have fit
             self._relieve(nbytes)
+        def _fits_locked() -> bool:
+            if self._used_locked() + nbytes > self.limit:
+                return False
+            cap = self._caps.get(label, 0)
+            return cap <= 0 or \
+                self._label_used_locked(label) + nbytes <= cap
+
         with self._lock:
-            fits = self._used_locked() + nbytes <= self.limit
+            fits = _fits_locked()
+            globally = self._used_locked() + nbytes <= self.limit
         if not fits:
-            self._relieve(nbytes)
+            # a label-cap-only breach relieves scoped to the label;
+            # a global breach runs the full ladder
+            self._relieve(nbytes, label=None if not globally else label)
             with self._lock:
-                fits = self._used_locked() + nbytes <= self.limit
+                fits = _fits_locked()
         if not fits:
             with self._lock:
                 self.rejections[label] = \
@@ -170,34 +236,61 @@ class MemBudget:
     # --- pressure relief -------------------------------------------------
 
     def add_pressure_handler(
-            self, fn: Callable[[int], int]) -> None:
+            self, fn: Callable[[int], int], priority: int = 100,
+            key: str | None = None) -> None:
         """Register a memory-freeing hook run before a refusal:
         ``fn(need_bytes) -> freed_bytes_hint``. Bound methods are held
         through ``weakref.WeakMethod`` so registering never pins the
-        owner (a test's ShardedCollection must be collectable)."""
+        owner (a test's ShardedCollection must be collectable).
+
+        Handlers run in ascending ``priority`` order and the pass stops
+        as soon as the budget fits — the tenant plane registers at a
+        LOW priority so device pressure sheds cold tenants before the
+        cache plane flushes anything. ``key`` makes registration
+        idempotent (re-adding the same key replaces the old entry —
+        singletons re-attach safely after a ``reset()``)."""
         with self._lock:
             try:
                 ref: object = weakref.WeakMethod(fn)  # bound method
             except TypeError:
                 ref = weakref.ref(fn) if hasattr(fn, "__name__") \
                     else (lambda: fn)
-            self._pressure.append(ref)
+            if key is not None:
+                self._pressure = [e for e in self._pressure
+                                  if e[2] != key]
+            self._pressure_seq += 1
+            self._pressure.append(
+                (int(priority), self._pressure_seq, key, ref))
 
-    def _relieve(self, need: int) -> None:
+    def _relieve(self, need: int, label: str | None = None) -> None:
+        """The shed pass: handlers ascending by priority, stopping the
+        moment the budget (or, for a cap breach, the label) fits —
+        cheap shedders spare expensive ones. At least one handler
+        always runs (chaos-forced pressure exercises the pass even
+        when the reservation would fit)."""
         with self._lock:
-            refs = list(self._pressure)
-        live = []
-        for ref in refs:
-            fn = ref()
+            entries = sorted(self._pressure, key=lambda e: (e[0], e[1]))
+        dead = []
+        ran = 0
+        for entry in entries:
+            fn = entry[3]()
             if fn is None:
-                continue  # owner collected: drop the handler
-            live.append(ref)
+                dead.append(entry)  # owner collected: drop the handler
+                continue
             try:
                 fn(need)
             except Exception as e:  # noqa: BLE001 — relief best-effort
                 log.warning("pressure handler failed: %s", e)
-        with self._lock:
-            self._pressure = live
+            ran += 1
+            with self._lock:
+                fits = self._label_fits_locked(label) if label \
+                    else self._used_locked() + need <= self.limit
+            if fits:
+                break
+        if dead:
+            with self._lock:
+                self._pressure = [e for e in self._pressure
+                                  if e not in dead]
 
     # --- introspection (/admin/mem) -------------------------------------
 
@@ -212,6 +305,7 @@ class MemBudget:
                     "gauged": sum(
                         self._gauges.get(lb, {}).values()),
                     "rejections": self.rejections.get(lb, 0),
+                    "cap": self._caps.get(lb, 0),
                 }
             used = self._used_locked()
             return {
@@ -228,9 +322,11 @@ class MemBudget:
         with self._lock:
             self._reserved.clear()
             self._gauges.clear()
+            self._caps.clear()
             self.rejections.clear()
             self.high_water = 0
             self._pressure = []
+            self._relieving.clear()
 
 
 #: process-wide singleton (reference ``g_mem``)

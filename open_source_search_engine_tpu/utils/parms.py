@@ -65,6 +65,8 @@ PARMS: list[Parm] = [
     _p("ssl_key", "sslkey", str, "", GLOBAL, "TLS private key path (empty = key inside ssl_cert)", broadcast=False),
     _p("serve_device", "sdev", bool, True, GLOBAL, "serve /search from the HBM-resident index with micro-batching (SURVEY §7.8 throughput mode)"),
     _p("serve_mesh", "smesh", bool, False, GLOBAL, "sharded instances serve /search through the mesh-resident path: one shard_map program per wave, Msg3a merge + site dedup in-jit (SURVEY §7 stage 4/5)"),
+    _p("tenant_hot", "thot", int, 0, GLOBAL, "resident-tenant count bound for the tenancy plane's LRU hot set (serve.tenancy; addColl/delColl CollectionRec scale); 0 = unbounded"),
+    _p("device_budget", "devbudget", int, 0, GLOBAL, "soft byte cap on the membudget 'device' label — HBM-resident tenant bases; breach parks cold tenants (membudget.cap_evict); 0 = uncapped"),
     _p("merge_quiet_hours", "mergehours", str, "", GLOBAL, "DailyMerge window (DailyMerge.h:11)"),
     _p("alert_cmd", "alertcmd", str, "", GLOBAL, "command run on host death/recovery with OSSE_ALERT_* env (PingServer.h:77 email/SMS role); empty = log only", broadcast=False),
     _p("trace_sample", "tsample", int, 64, GLOBAL, "head-sample 1 in N query traces (utils.trace, Dapper-style); 1 = every query, 0 = tracing off"),

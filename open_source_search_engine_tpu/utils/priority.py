@@ -34,12 +34,21 @@ TIERS: tuple[str, ...] = ("interactive", "suggest", "crawlbot")
 #: X-OSSE-Deadline carries the budget and X-OSSE-Trace the span)
 PRIORITY_HEADER = "X-OSSE-Priority"
 
+#: the tenant (collection owner) a request bills against — the
+#: admission plane's weighted-fair ledger key, carried across wire
+#: legs exactly like the tier so a scatter leg sheds against the same
+#: quota its coordinator would
+TENANT_HEADER = "X-OSSE-Tenant"
+
 #: tier -> the niceness bit the node planes honor (crawlbot work yields
 #: to interactive inside each host, not just at the front door)
 _TIER_NICENESS = {"interactive": 0, "suggest": 0, "crawlbot": 1}
 
 _ctx: contextvars.ContextVar = contextvars.ContextVar(
     "osse-priority-tier", default=None)
+
+_tenant_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "osse-priority-tenant", default=None)
 
 
 class QueueFull(RuntimeError):
@@ -70,6 +79,30 @@ def tier_from_header(value: str | None) -> str | None:
     (the receiver falls back to its own classification)."""
     v = (value or "").strip().lower()
     return v if v in TIERS else None
+
+
+def current_tenant() -> str | None:
+    """The tenant bound to this context, or None outside a request."""
+    return _tenant_ctx.get()
+
+
+@contextlib.contextmanager
+def bind_tenant(tenant: str | None):
+    """Bind the billing tenant for the duration; outbound RPCs stamp
+    it on :data:`TENANT_HEADER` (the quota analog of tier)."""
+    tok = _tenant_ctx.set(tenant)
+    try:
+        yield
+    finally:
+        _tenant_ctx.reset(tok)
+
+
+def tenant_from_header(value: str | None) -> str | None:
+    """Parse an ``X-OSSE-Tenant`` header; absent/oversized -> None.
+    Tenant names are free-form collection names, so only length is
+    policed (a hostile header must not mint unbounded counter keys)."""
+    v = (value or "").strip()
+    return v[:64] if v else None
 
 
 def tier_niceness(tier: str | None) -> int:

@@ -1,0 +1,11 @@
+# osselint: path=open_source_search_engine_tpu/serve/fixture_tenancy.py
+"""Clean counterpart of violations_tenancy.py: device residency flows
+through the engine factories, so the ResidencyManager owns eviction,
+device-label billing, and delColl teardown."""
+from ..query.engine import build_device_index, get_resident_loop
+
+
+def serve_collection(coll, deadline=None):
+    di = build_device_index(coll)
+    loop = get_resident_loop(coll, deadline=deadline)
+    return di, loop

@@ -1,0 +1,12 @@
+# osselint: path=open_source_search_engine_tpu/serve/fixture_tenancy.py
+"""residency-bypass fixture: HBM-resident state minted behind the
+ResidencyManager's back — a hand-built DeviceIndex the tenant LRU
+can never evict and a hand-spun ResidentLoop delColl can never stop."""
+from ..query.devindex import DeviceIndex
+from ..query.resident import ResidentLoop
+
+
+def serve_collection(coll):
+    di = DeviceIndex(coll)  # EXPECT residency-bypass
+    loop = ResidentLoop(lambda: di, lambda: 0)  # EXPECT residency-bypass
+    return loop

@@ -208,9 +208,10 @@ class TestBuildAndPackDegrade:
         assert freed >= 1 << 20
         assert fat.mem.nbytes == 0 and len(fat.runs) == 1
         # weakref: a collected ShardedCollection leaves no live handler
+        # (entries are (priority, seq, key, ref) since label caps)
         del sc, fat
         gc.collect()
-        assert all(ref() is None for ref in g_membudget._pressure)
+        assert all(e[3]() is None for e in g_membudget._pressure)
 
 
 # ----------------------------------------------------- device plane

@@ -221,6 +221,29 @@ class TestSeededRegressions:
             legal,
             "open_source_search_engine_tpu/parallel/cluster.py") == []
 
+    def test_residency_bypass_outside_tenancy_plane_is_caught(self):
+        # the literal pre-tenancy shape: sharded.py built a DeviceIndex
+        # per shard and spun its own ResidentLoop — HBM buffers the
+        # ResidencyManager never saw, so the tenant LRU couldn't evict
+        # them, the 'device' label never billed them, and delColl
+        # couldn't unserve them
+        src = ("from ..query.devindex import DeviceIndex\n"
+               "from ..query.resident import ResidentLoop\n"
+               "def boot(coll):\n"
+               "    di = DeviceIndex(coll)\n"
+               "    return ResidentLoop(lambda: di, lambda: 0)\n")
+        found = osselint.check_source(
+            src, "open_source_search_engine_tpu/parallel/sharded.py")
+        assert [f.rule for f in found] == ["residency-bypass",
+                                          "residency-bypass"]
+        # the residency plane and the engine factories ARE the owners
+        assert osselint.check_source(
+            src, "open_source_search_engine_tpu/serve/tenancy.py") == []
+        assert osselint.check_source(
+            src, "open_source_search_engine_tpu/query/engine.py") == []
+        # tests construct loops directly against fakes — out of scope
+        assert osselint.check_source(src, "tests/test_resident.py") == []
+
 
 class TestJitSeededRegressions:
     """The literal jit hazard shapes the PR 7 rules caught (or

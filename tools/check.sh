@@ -14,7 +14,8 @@ python -m tools.osselint
 #    fixtures actually produce findings (the exact-line marker match
 #    lives in tests/test_lint.py)
 python -m tools.osselint tests/lint_fixtures/clean_parallel.py \
-    tests/lint_fixtures/clean_jit.py tests/lint_fixtures/clean_mesh.py
+    tests/lint_fixtures/clean_jit.py tests/lint_fixtures/clean_mesh.py \
+    tests/lint_fixtures/clean_tenancy.py
 for f in tests/lint_fixtures/violations_*.py; do
     if python -m tools.osselint "$f" > /dev/null 2>&1; then
         echo "check.sh: $f produced no findings" >&2
@@ -59,7 +60,17 @@ BENCH_LOAD=1 BENCH_LOAD_QPS=6,12 BENCH_LOAD_SECONDS=2 \
 BENCH_FLEET=1 BENCH_FLEET_SECONDS=5 BENCH_FLEET_QPS=8 \
     JAX_PLATFORMS=cpu python bench.py
 
-# 7. mesh serving smoke: a SHORT scale curve of the in-jit Msg3a merge
+# 7. tenant smoke: a SHORT Zipf sweep over 64 collections with a
+#    32-slot residency budget — gates the hot-set residency hit rate,
+#    bounded post-compile cold starts, zero membudget refusals, and
+#    weighted-fair quotas keeping a quiet tenant shed-free under a
+#    flood (bench.py main_tenants docstring; the 1k-collection shape
+#    runs nightly via BENCH_TENANTS=1 defaults)
+BENCH_TENANTS=1 BENCH_TENANTS_COLLS=64 BENCH_TENANTS_HOT=32 \
+    BENCH_TENANTS_QUERIES=300 \
+    JAX_PLATFORMS=cpu python bench.py
+
+# 8. mesh serving smoke: a SHORT scale curve of the in-jit Msg3a merge
 #    (subprocess per point, forced host devices) — gates the 4-shard
 #    in-jit merge's speedup over the single-chip path on the same
 #    corpus, zero compiles/retraces/off-boundary transfers across

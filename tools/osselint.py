@@ -34,6 +34,12 @@ reference-engine discipline that keeps it from shipping one):
   ``parallel/fleet.py`` and ``utils/chaos.py`` spawn or kill processes
   no supervisor tracks and no teardown reaps — exactly the orphan
   leaks the FleetManager process groups exist to prevent.
+* ``residency-bypass`` — HBM-resident state is the tenancy plane's
+  job: a ``DeviceIndex(`` / ``ResidentLoop(`` constructed outside
+  ``serve/tenancy.py`` and the ``query/engine.py`` factories creates
+  device buffers the ResidencyManager never sees — the LRU can't
+  evict them, the membudget 'device' label never bills them, and
+  delColl can't unserve them.
 
 The ``jit-*`` family covers JAX trace discipline — the failure modes
 are invisible until they show up as a latency cliff (the Gigablast
@@ -443,6 +449,43 @@ def _proc_scope(rel: str) -> bool:
     if rel in (f"{PKG}/parallel/fleet.py", f"{PKG}/utils/chaos.py"):
         return False
     return rel.startswith((f"{PKG}/", "tests/"))
+
+
+#: the classes whose construction mints HBM-resident state
+_RESIDENCY_CLASSES = {"DeviceIndex", "ResidentLoop"}
+
+
+def rule_residency_bypass(ctx: Ctx) -> list[Finding]:
+    """DeviceIndex/ResidentLoop constructed outside the residency
+    plane — device buffers the ResidencyManager never tracks: the
+    tenant LRU can't evict them under membudget pressure, the
+    'device' label never bills them, and delColl can't unserve
+    them. Go through query/engine's factories
+    (``build_device_index`` / ``spawn_resident_loop`` /
+    ``get_resident_loop``), which serve/tenancy.py owns."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        tail = name.split(".")[-1] if name else ""
+        if tail in _RESIDENCY_CLASSES:
+            out.append(Finding(
+                ctx.rel, node.lineno, "residency-bypass",
+                f"{tail}() outside the residency plane — buffers the "
+                "ResidencyManager can't evict, bill, or unserve; use "
+                "query/engine's build_device_index / "
+                "spawn_resident_loop / get_resident_loop (owned by "
+                "serve/tenancy.py)"))
+    return out
+
+
+def _residency_scope(rel: str) -> bool:
+    """Package only, minus the residency plane and the engine
+    factories. Tests stay out of scope — they construct ResidentLoop
+    directly against fakes."""
+    return _in_pkg(rel) and rel not in (
+        f"{PKG}/serve/tenancy.py", f"{PKG}/query/engine.py")
 
 
 def _module_mutables(tree: ast.Module) -> set[str]:
@@ -1149,6 +1192,7 @@ RULES = [
     ("adhoc-timing", _timed_scope, rule_adhoc_timing),
     ("admission-bypass", _admission_scope, rule_admission_bypass),
     ("proc-spawn", _proc_scope, rule_proc_spawn),
+    ("residency-bypass", _residency_scope, rule_residency_bypass),
 ]
 
 RULE_NAMES = {name for name, _p, _c in RULES}
