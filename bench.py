@@ -876,6 +876,169 @@ def main_jit() -> None:
         sys.exit(1)
 
 
+def _build_cols_mismatch(host, dev) -> list:
+    """Names of device-index columns that differ bitwise from the host
+    oracle's (empty == bit-exact)."""
+    import numpy as np
+    bad = []
+    for name in ("dir_termids", "base_df", "dir_dstart", "dir_pstart",
+                 "base_docids", "h_doc_col", "d_payload", "d_docc",
+                 "d_doc", "d_rs", "d_cnt", "d_siterank", "d_doclang",
+                 "d_cube", "d_dense_rs", "d_dense_cnt"):
+        a = np.asarray(getattr(host, name))
+        b = np.asarray(getattr(dev, name))
+        if a.shape != b.shape or not np.array_equal(a, b):
+            bad.append(name)
+    for name in ("d_imp", "d_dense_imp"):
+        a = np.asarray(getattr(host, name)).view(np.uint16)
+        b = np.asarray(getattr(dev, name)).view(np.uint16)
+        if a.shape != b.shape or not np.array_equal(a, b):
+            bad.append(name)
+    return bad
+
+
+def main_build() -> dict:
+    """Ingest-plane gate (BENCH_BUILD=1): the device posting
+    sort/dedup/pack pipeline (``build/devbuild.py``) measured end to
+    end. Three legs, all must hold:
+
+    1. parity — a seeded multi-run corpus (tombstones, re-adds) built
+       by the device plane must be BITWISE equal to the host oracle:
+       every base column, directory table and f16 impact;
+    2. throughput — index BENCH_BUILD_DOCS docs through the real
+       tokenize/pack pipeline, then time a cold full device base
+       rebuild; the rebuild must land under BENCH_BUILD_REBUILD_S
+       (default 60 s — r04 measured ~450 s of host build at 100k docs)
+       and the measured docs/s is the emitted metric;
+    3. jit discipline — repeated same-bucket delta folds under
+       jitwatch: zero compiles/retraces once the bucket is warm.
+
+    Prints ONE JSON line stamped by ``_backend_record()``; returns the
+    report."""
+    from open_source_search_engine_tpu.build import docproc
+    from open_source_search_engine_tpu.index.collection import Collection
+    from open_source_search_engine_tpu.query.devindex import DeviceIndex
+    from open_source_search_engine_tpu.utils import jitwatch
+    from open_source_search_engine_tpu.utils.stats import g_stats
+
+    def _ctr(name: str) -> int:
+        return g_stats.counters.get(name, 0)
+
+    # --- leg 1: bitwise parity vs the host oracle -------------------
+    p_docs = int(os.environ.get("BENCH_BUILD_PARITY_DOCS", "300"))
+    pdir = tempfile.mkdtemp(prefix="osse_bench_build_par_")
+    pc = Collection("par", pdir)
+    pd = list(_gen_docs(p_docs))
+    docproc.index_batch(pc, pd[:p_docs // 2])
+    pc.posdb.dump()
+    pc.titledb.dump()
+    docproc.index_batch(pc, pd[p_docs // 2:])
+    pc.posdb.dump()
+    # run 3: tombstones + a re-add so annihilation crosses run bounds
+    docproc.remove_document(pc, pd[1][0])
+    docproc.index_document(pc, *pd[2])
+    pc.posdb.dump()
+    fb0 = _ctr("build.devbuild_fallback")
+    # device first — the device plane never writes the disk cache, so
+    # the host oracle build below derives from scratch
+    os.environ["OSSE_DEVBUILD"] = "1"
+    dev = DeviceIndex(pc)
+    os.environ["OSSE_DEVBUILD"] = "0"
+    host = DeviceIndex(pc)
+    os.environ["OSSE_DEVBUILD"] = "1"
+    mismatch = _build_cols_mismatch(host, dev)
+    parity_ok = not mismatch and _ctr("build.devbuild_fallback") == fb0
+    shutil.rmtree(pdir, ignore_errors=True)
+
+    # --- leg 2: measured ingest + cold device rebuild ---------------
+    n_docs = int(os.environ.get("BENCH_BUILD_DOCS", str(N_DOCS)))
+    bound_s = float(os.environ.get("BENCH_BUILD_REBUILD_S", "60"))
+    bdir = os.environ.get("BENCH_DIR") or tempfile.mkdtemp(
+        prefix="osse_bench_build_")
+    coll = Collection("bench", bdir)
+    t0 = time.perf_counter()
+    built = coll.num_docs < n_docs
+    if built:
+        chunk: list = []
+        done = 0
+        for url, html in _gen_docs(n_docs):
+            chunk.append((url, html))
+            if len(chunk) >= 512:
+                docproc.index_batch(coll, chunk)
+                done += len(chunk)
+                chunk = []
+                if done % 20480 == 0:
+                    print(f"# indexed {done}/{n_docs} "
+                          f"({done / (time.perf_counter() - t0):.0f} "
+                          "docs/s)", file=sys.stderr)
+        if chunk:
+            docproc.index_batch(coll, chunk)
+        coll.posdb.dump()
+        coll.titledb.dump()
+        coll.save()
+    index_s = time.perf_counter() - t0
+    # a cold rebuild: the host pipeline's disk cache would short-circuit
+    # _build_base entirely and time a np.load instead of the plane
+    shutil.rmtree(coll.posdb.dir / "devcache", ignore_errors=True)
+    db0 = _ctr("build.device_base")
+    fb1 = _ctr("build.devbuild_fallback")
+    t0 = time.perf_counter()
+    idx = DeviceIndex(coll)
+    rebuild_s = time.perf_counter() - t0
+    device_ran = _ctr("build.device_base") == db0 + 1 \
+        and _ctr("build.devbuild_fallback") == fb1
+    rebuild_ok = device_ran and rebuild_s < bound_s
+
+    # --- leg 3: same-bucket delta folds stay compile-free -----------
+    waves = int(os.environ.get("BENCH_BUILD_WAVES", "6"))
+    per_wave = int(os.environ.get("BENCH_BUILD_WAVE_DOCS", "16"))
+
+    def _wave(w: int) -> list:
+        # tiny fixed-shape docs: every fold lands in the same padded
+        # shape bucket, so steady state must not compile or retrace
+        return [(f"http://fold{w}.bench.test/d{i}",
+                 f"<html><body><p>fold words batch{w % 3} tok{i % 7} "
+                 "steady bucket probe</p></body></html>")
+                for i in range(per_wave)]
+
+    jitwatch.enable()
+    docproc.index_batch(coll, _wave(0))   # warm: compiles the bucket
+    idx.refresh()
+    jitwatch.reset()
+    for w in range(1, waves + 1):
+        docproc.index_batch(coll, _wave(w))
+        idx.refresh()
+    snap = jitwatch.snapshot()
+    t = snap["totals"]
+    jit_ok = t["compiles"] == 0 and t["retraces"] == 0
+
+    ok = parity_ok and rebuild_ok and jit_ok
+    rebuild_dps = n_docs / rebuild_s if rebuild_s > 0 else 0.0
+    rep = {
+        "metric": "build_docs_per_sec",
+        "value": round(rebuild_dps, 1),
+        "unit": "docs/s",
+        "docs": n_docs,
+        "index_s": round(index_s, 2),
+        "index_docs_per_s": round(n_docs / index_s, 1)
+        if built and index_s > 0 else None,
+        "rebuild_s": round(rebuild_s, 2),
+        "rebuild_bound_s": bound_s,
+        "device_ran": device_ran,
+        "parity": {"docs": p_docs, "ok": parity_ok,
+                   "mismatch": mismatch},
+        "jit": {"waves": waves, "wave_docs": per_wave,
+                "compiles": t["compiles"], "retraces": t["retraces"],
+                "ok": jit_ok},
+        "ok": ok,
+        **_backend_record(),
+        "budget": f"bit-exact parity + cold rebuild < {bound_s:.0f}s "
+                  "+ zero steady-state compiles/retraces",
+    }
+    print(json.dumps(rep))
+    return rep
+
+
 def main() -> None:
     try:
         jax = _init_backend()
@@ -2214,6 +2377,8 @@ if __name__ == "__main__":
         main_dispatch()
     elif os.environ.get("BENCH_JIT"):
         main_jit()
+    elif os.environ.get("BENCH_BUILD"):
+        sys.exit(0 if main_build()["ok"] else 1)
     elif os.environ.get("BENCH_SLO"):
         sys.exit(0 if main_slo()["ok"] else 1)
     elif os.environ.get("BENCH_LOAD"):

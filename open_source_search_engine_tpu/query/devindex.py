@@ -95,12 +95,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..build import devbuild
 from ..index import clusterdb as clusterdb_mod
 from ..index import posdb
 from ..index.collection import Collection
 from ..index.rdblite import merge_batches
 from ..utils import jitwatch, trace
 from ..utils.log import get_logger
+from ..utils.stats import g_stats
 from . import devcheck, weights
 from .compiler import SUB_SYNONYM, QueryPlan, compile_query
 from .packer import (IMPACT_SCALE, MAX_POSITIONS, T_FLOOR, TABLE_SIZE,
@@ -380,14 +382,6 @@ def _build_dense_rows(d_doc, d_imp, d_rs, d_cnt, starts, cum,
     return imp.reshape(V, D), rs, cnt
 
 
-@partial(jax.jit, static_argnames=("total",))
-def _build_cube_rows(payload, src, dst, total: int):
-    """Materialize the cube rows device-side: one scatter of the cube
-    terms' postings (pad lanes carry dst == total → dropped)."""
-    return jnp.zeros((total,), jnp.uint32).at[dst].set(
-        payload[jnp.clip(src, 0, payload.shape[0] - 1)], mode="drop")
-
-
 class _DeltaOverflow(Exception):
     def __init__(self, needed_docs: int = 0, needed_cols: int = 0):
         self.needed_docs = needed_docs
@@ -596,8 +590,6 @@ class DeviceIndex:
                          langid) -> None:
         p = self._cache_path(fp)
         p.parent.mkdir(parents=True, exist_ok=True)
-        for old in p.parent.glob("base_*.npz"):
-            old.unlink()  # only the live fingerprint is useful
         tmp = p.with_suffix(".tmp.npz")
         np.savez(tmp, dir_termids=self.dir_termids,
                  base_df=self.base_df, dir_dstart=self.dir_dstart,
@@ -607,6 +599,23 @@ class DeviceIndex:
                  rs_col=rs_col, cnt_col=cnt_col, siterank=siterank,
                  langid=langid)
         tmp.rename(p)
+        # stale fingerprints go only AFTER the new cache landed: a crash
+        # mid-savez used to leave NO cache at all, forcing a full
+        # rebuild on next boot (the classic swap-order bug)
+        for old in p.parent.glob("base_*.npz"):
+            if old != p:
+                old.unlink()  # only the live fingerprint is useful
+
+    def _postings_overflow(self) -> ValueError:
+        """The 2^31-postings runstart pack limit, as a counted,
+        admin-visible condition (the /admin/perf shard-split alert) —
+        a fleet operator sees the counter before the node boot-loops
+        on the raise."""
+        g_stats.count("build.postings_overflow")
+        return ValueError(
+            f"shard exceeds {_MAX_POSTINGS} stored postings "
+            "(runstart pack limit) — split the collection "
+            "across more shards")
 
     def _build_base(self, fp, min_docs: int = 0, min_delta: int = 0
                     ) -> None:
@@ -616,6 +625,7 @@ class DeviceIndex:
         runs = self.coll.posdb.runs
         P = self.P
         cached = self._load_base_cache(fp)
+        dv = None
         if cached is not None:
             (self.dir_termids, self.base_df, self.dir_dstart,
              self.dir_pstart, self.base_docids, docidx, pocc, payload,
@@ -624,10 +634,36 @@ class DeviceIndex:
             n = len(docidx)
             batch = None
         else:
-            batch = merge_batches([r.batch() for r in runs]) \
-                if runs else None
+            if devbuild.enabled() and runs:
+                # the device ingest plane: merge + derive on-chip, the
+                # host NumPy pipeline below stays as oracle + fallback
+                try:
+                    dv = devbuild.build_base(
+                        [r.batch().keys for r in runs], self._put)
+                except Exception:
+                    log.exception("device base build failed — falling "
+                                  "back to the host pipeline")
+                    g_stats.count("build.devbuild_fallback")
+                    dv = None
+            batch = None if dv is not None else (
+                merge_batches([r.batch() for r in runs])
+                if runs else None)
         if cached is not None:
             pass
+        elif dv is not None:
+            # columns already live in HBM; only the directory tables,
+            # docid map and doc_col came back to host
+            self.dir_termids = dv.dir_termids
+            self.base_df = dv.df
+            self.dir_dstart = dv.dir_dstart
+            self.dir_pstart = dv.dir_pstart
+            self.base_docids = dv.base_docids
+            doc_col = dv.h_doc_col
+            n = dv.n
+            if n >= _MAX_POSTINGS:
+                raise self._postings_overflow()
+            docidx = pocc = payload = imp_col = rs_col = cnt_col = None
+            siterank = langid = None
         elif batch is not None and len(batch):
             f = posdb.unpack(batch.keys)
             termids, docids = f["termid"], f["docid"]
@@ -640,10 +676,7 @@ class DeviceIndex:
             f = {k: v[keep] for k, v in f.items()}
             termids, docids = f["termid"], f["docid"]
             if len(termids) >= _MAX_POSTINGS:
-                raise ValueError(
-                    f"shard exceeds {_MAX_POSTINGS} stored postings "
-                    "(runstart pack limit) — split the collection "
-                    "across more shards")
+                raise self._postings_overflow()
             payload = pack_payload(f)
             self.base_docids = np.unique(docids)
             docidx = np.searchsorted(self.base_docids, docids).astype(
@@ -702,7 +735,7 @@ class DeviceIndex:
         # final_multipliers actually needs per doc ---
         sr = np.zeros(self.D_cap, np.uint8)
         dl = np.zeros(self.D_cap, np.uint8)
-        if n:
+        if n and dv is None:
             first = np.unique(docidx, return_index=True)[1]
             sr[docidx[first]] = siterank[first]
             dl[docidx[first]] = langid[first]
@@ -767,15 +800,15 @@ class DeviceIndex:
         Vc = _bucket(len(cube_terms) + 1, 4)
         self.cube_zero_slot = Vc - 1
         self.cube_slot_of: dict[int, int] = {}
-        cube_src: list[np.ndarray] = []
-        cube_dst: list[np.ndarray] = []
+        # per-slot posting-run descriptors only — the scatter targets
+        # derive on-device from the resident docc column (docidx<<4 |
+        # occ), so neither build path ships posting-sized dst arrays
+        c_starts = np.zeros(max(len(cube_terms), 1), np.int32)
+        c_lens = np.zeros(max(len(cube_terms), 1), np.int64)
         for slot, ti in enumerate(cube_terms):
             a, b = int(self.dir_pstart[ti]), int(self.dir_pstart[ti + 1])
-            src = np.arange(a, b, dtype=np.int64)
-            dst = ((slot * P + pocc[a:b].astype(np.int64)) * self.D_cap
-                   + docidx[a:b])
-            cube_src.append(src)
-            cube_dst.append(dst)
+            c_starts[slot] = a
+            c_lens[slot] = b - a
             self.cube_slot_of[int(self.dir_termids[ti])] = slot
 
         # --- device columns: base + preallocated delta tail ---
@@ -786,20 +819,37 @@ class DeviceIndex:
         self.N2 = max(_bucket(max(self.Nb // 4, min_delta, 1),
                               COL_QUANTUM), COL_QUANTUM)
         self.M2 = self.N2
-        self.d_payload = self._put(
-            _pad_col(payload, self.Nb + self.N2))
-        docc = ((docidx.astype(np.uint32) << _OCC_BITS)
-                | pocc.astype(np.uint32))
-        self.d_docc = self._put(_pad_col(docc, self.Nb + self.N2))
-        self.d_doc = self._put(_pad_col(doc_col, self.Mb + self.M2))
-        # packed resident impacts: the disk cache keeps exact f32 (the
-        # schema is unchanged); demotion to round-up f16 happens at
-        # device-put time so HBM holds half the impact bytes while the
-        # bounds stay admissible (demote_impacts docstring)
-        self.d_imp = self._put(_pad_col(demote_impacts(imp_col),
-                                        self.Mb + self.M2))
-        self.d_rs = self._put(_pad_col(rs_col, self.Mb + self.M2))
-        self.d_cnt = self._put(_pad_col(cnt_col, self.Mb + self.M2))
+        if dv is not None:
+            # device-built columns never left HBM: slice/zero-extend
+            # them into the base+delta capacity (rows past dv.n are
+            # already zero — the _pad_col convention holds on-device)
+            self.d_payload = devbuild.fit(dv.cols["payload"],
+                                          self.Nb + self.N2)
+            self.d_docc = devbuild.fit(dv.cols["docc"],
+                                       self.Nb + self.N2)
+            self.d_doc = devbuild.fit(dv.cols["doc_col"],
+                                      self.Mb + self.M2)
+            self.d_imp = devbuild.fit(dv.cols["imp16"],
+                                      self.Mb + self.M2)
+            self.d_rs = devbuild.fit(dv.cols["rs"], self.Mb + self.M2)
+            self.d_cnt = devbuild.fit(dv.cols["cnt"],
+                                      self.Mb + self.M2)
+        else:
+            self.d_payload = self._put(
+                _pad_col(payload, self.Nb + self.N2))
+            docc = ((docidx.astype(np.uint32) << _OCC_BITS)
+                    | pocc.astype(np.uint32))
+            self.d_docc = self._put(_pad_col(docc, self.Nb + self.N2))
+            self.d_doc = self._put(_pad_col(doc_col, self.Mb + self.M2))
+            # packed resident impacts: the disk cache keeps exact f32
+            # (the schema is unchanged); demotion to round-up f16
+            # happens at device-put time so HBM holds half the impact
+            # bytes while the bounds stay admissible (demote_impacts
+            # docstring)
+            self.d_imp = self._put(_pad_col(demote_impacts(imp_col),
+                                            self.Mb + self.M2))
+            self.d_rs = self._put(_pad_col(rs_col, self.Mb + self.M2))
+            self.d_cnt = self._put(_pad_col(cnt_col, self.Mb + self.M2))
         dr_cum = np.r_[0, np.cumsum(dr_lens)].astype(np.int32)
         (self.d_dense_imp, self.d_dense_rs,
          self.d_dense_cnt) = _build_dense_rows(
@@ -807,21 +857,22 @@ class DeviceIndex:
             self._put(dr_starts), self._put(dr_cum),
             V=V, D=self.D_cap,
             n_lanes=_bucket(max(int(dr_cum[-1]), 1), COL_QUANTUM))
-        self.d_siterank = self._put(sr)
-        self.d_doclang = self._put(dl)
+        if dv is not None:
+            self.d_siterank, self.d_doclang = devbuild.doc_meta(
+                self._put(sr), self._put(dl), dv)
+        else:
+            self.d_siterank = self._put(sr)
+            self.d_doclang = self._put(dl)
         self.d_dead = self._put(np.zeros(self.D_cap, bool))
         self.Vc = Vc
         total = Vc * P * self.D_cap
-        if cube_src:
-            csrc = np.concatenate(cube_src)
-            cdst = np.concatenate(cube_dst)
-            ncube = _bucket(len(csrc), COL_QUANTUM)
-            dstp = np.full(ncube, total, np.int64)  # pad → dropped
-            dstp[: len(cdst)] = cdst
-            self.d_cube = _build_cube_rows(
-                self.d_payload,
-                self._put(_pad_col(csrc.astype(np.int32), ncube)),
-                self._put(dstp), total=total)
+        c_cum = np.r_[0, np.cumsum(c_lens)].astype(np.int32)
+        if len(cube_terms):
+            self.d_cube = devbuild._cube_rows(
+                self.d_payload, self.d_docc, self._put(c_starts),
+                self._put(c_cum), D=self.D_cap, n_positions=P,
+                total=total,
+                n_lanes=_bucket(max(int(c_cum[-1]), 1), COL_QUANTUM))
         else:
             self.d_cube = jnp.zeros((total,), jnp.uint32)
         self._base_fp = fp
@@ -899,6 +950,58 @@ class DeviceIndex:
             docidx = np.where(
                 p_ok, p_di,
                 Db + np.searchsorted(new_docids, p_doc)).astype(np.int32)
+            dv2 = None
+            if devbuild.enabled():
+                try:
+                    dv2 = devbuild.build_delta(fp_, docidx, self._put)
+                except Exception:
+                    log.exception("device delta fold failed — falling "
+                                  "back to the host pipeline")
+                    g_stats.count("build.devbuild_fallback")
+                    dv2 = None
+            if dv2 is not None:
+                n2, m2 = dv2.n, dv2.n_pairs
+                if n2 > self.N2 or m2 > self.M2:
+                    raise _DeltaOverflow(needed_cols=max(n2, m2))
+                if self.Nb + n2 >= _MAX_POSTINGS:
+                    raise self._postings_overflow()
+                self.dir2_termids = dv2.dir_termids
+                self.delta_df = dv2.df
+                self.dir2_dstart = dv2.dir_dstart
+                self.dir2_pstart = dv2.dir_pstart
+                self.all_docids = np.concatenate(
+                    [self.base_docids, new_docids])
+                # donated in-place rewrites straight from the derive
+                # outputs — the fold never round-trips through host
+                self.d_payload = _write_tail(
+                    self.d_payload,
+                    devbuild.fit(dv2.cols["payload"], self.N2),
+                    np.int32(self.Nb))
+                self.d_docc = _write_tail(
+                    self.d_docc,
+                    devbuild.fit(dv2.cols["docc"], self.N2),
+                    np.int32(self.Nb))
+                self.d_doc = _write_tail(
+                    self.d_doc,
+                    devbuild.fit(dv2.cols["doc_col"], self.M2),
+                    np.int32(self.Mb))
+                self.d_imp = _write_tail(
+                    self.d_imp,
+                    devbuild.fit(dv2.cols["imp16"], self.M2),
+                    np.int32(self.Mb))
+                self.d_rs = _write_tail(
+                    self.d_rs,
+                    devbuild.offset_runstarts(dv2, self.Nb, self.M2),
+                    np.int32(self.Mb))
+                self.d_cnt = _write_tail(
+                    self.d_cnt,
+                    devbuild.fit(dv2.cols["cnt"], self.M2),
+                    np.int32(self.Mb))
+                self.d_siterank, self.d_doclang = devbuild.doc_meta(
+                    self.d_siterank, self.d_doclang, dv2)
+                self.d_dead = self._put(dead)
+                self.delta_rebuilds += 1
+                return
             # delta sort key is (termid, DOC-INDEX, wordpos): new docs'
             # indexes aren't docid-monotonic
             order = np.lexsort((fp_["wordpos"], docidx, fp_["termid"]))
@@ -920,9 +1023,7 @@ class DeviceIndex:
             if n2 > self.N2 or len(doc2_col) > self.M2:
                 raise _DeltaOverflow(needed_cols=max(n2, len(doc2_col)))
             if self.Nb + n2 >= _MAX_POSTINGS:
-                raise ValueError(
-                    f"shard exceeds {_MAX_POSTINGS} stored postings — "
-                    "split the collection across more shards")
+                raise self._postings_overflow()
             count2 = np.diff(np.r_[runstart2, n2])
             imp2 = _impacts_np(fp_, fp_["termid"], docidx, runstart2)
             # runstarts reference the combined column: delta postings

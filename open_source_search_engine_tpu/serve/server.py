@@ -1227,6 +1227,20 @@ class SearchHTTPServer:
             except Exception:
                 g_stats.count("slo.eval_errors")
         slo_status = g_slo.status()
+        # operator-visible build alerts: a shard at the runstart pack
+        # limit keeps boot-looping on the ValueError until it is split —
+        # surface the counter here, where a fleet operator looks first
+        alerts = []
+        n_ovf = fleet["counters"].get("build.postings_overflow", 0)
+        if n_ovf:
+            alerts.append({
+                "name": "shard_split_needed",
+                "count": n_ovf,
+                "hint": ("a shard hit the 2^31 stored-postings pack "
+                         "limit (build.postings_overflow) — split the "
+                         "collection across more shards before the "
+                         "node boot-loops"),
+            })
         if query.get("format") == "json":
             body = {
                 "hosts": {
@@ -1246,6 +1260,7 @@ class SearchHTTPServer:
                         for k, st in fleet["latencies"].items()},
                 },
                 "slo": slo_status,
+                "alerts": alerts,
             }
             return 200, json.dumps(body), "application/json"
 
@@ -1311,9 +1326,14 @@ class SearchHTTPServer:
             f"<tr><td>{k}</td><td>{v}</td></tr>"
             for k, v in sorted(fleet["counters"].items()))
         up = sum(1 for w in hosts.values() if w is not None)
+        alert_html = "".join(
+            f'<p style="color:#fff;background:#c00;padding:6px">'
+            f"ALERT {a['name']} (&times;{a['count']}): {a['hint']}</p>"
+            for a in alerts)
         return 200, (
             "<html><head><title>gb perf</title></head><body>"
             "<h1>fleet perf</h1>"
+            f"{alert_html}"
             f"<p>{up}/{len(hosts)} hosts scraped &middot; "
             f'<a href="/admin/perf?format=json{sfx}">json</a> &middot; '
             f'<a href="/metrics">metrics</a></p>'

@@ -60,7 +60,7 @@ _JAX_LOGGERS = ("jax._src.pjit", "jax._src.interpreters.pxla",
 #: transfer attributed elsewhere is a hot-path violation (mirrors
 #: osselint's _JIT_TRANSFER_BOUNDARY)
 BOUNDARY_SITES = ("query/devindex.py", "query/scorer.py",
-                  "parallel/sharded.py")
+                  "parallel/sharded.py", "build/devbuild.py")
 
 _PKG_ROOT = Path(__file__).resolve().parent.parent
 _SELF_FILE = str(Path(__file__).resolve())

@@ -1,0 +1,25 @@
+# osselint: path=open_source_search_engine_tpu/build/devbuild.py
+# clean twin of violations_devbuild.py: the same stages expressed as
+# on-device orderings — jnp sorts and segmented scans are exactly what
+# the host-sort fence steers toward, so none of these may fire.
+import jax.numpy as jnp
+import numpy as np
+
+
+def merge_runs(keys):
+    order = jnp.argsort(keys, stable=True)
+    return keys[order]
+
+
+def doc_index(d_lo, d_hi):
+    od = jnp.lexsort((d_lo, d_hi))
+    return od
+
+
+def rank_terms(termids):
+    return jnp.sort(termids)
+
+
+def stage(host_rows):
+    # plain staging math stays host-side without tripping the fence
+    return np.concatenate([host_rows, host_rows]).astype(np.uint32)

@@ -1,0 +1,25 @@
+# osselint: path=open_source_search_engine_tpu/build/devbuild.py
+# host-sort fixture — the pragma re-scopes it to the device ingest
+# plane, where numpy orderings are fenced out. Each "EXPECT rule"
+# comment marks the line a finding must anchor to. Never scanned by
+# the real linter (lint_fixtures/ is excluded from directory walks).
+import numpy as np
+
+
+def merge_runs(keys):
+    order = np.argsort(keys)  # EXPECT host-sort
+    return keys[order]
+
+
+def doc_index(docids):
+    uniq = np.unique(docids)  # EXPECT host-sort
+    return np.searchsorted(uniq, docids)
+
+
+def rank_terms(termids):
+    ordered = np.sort(termids)  # EXPECT host-sort
+    return sorted(ordered.tolist())  # EXPECT host-sort
+
+
+def pair_order(termids, docidx):
+    return np.lexsort((docidx, termids))  # EXPECT host-sort

@@ -143,3 +143,29 @@ def test_search_never_creates_collections(srv, tmp_path):
         _get(srv, "/search?q=words&c=doesnotexist")
     assert e.value.code == 404
     assert not (srv.colldb.base_dir / "coll" / "doesnotexist").exists()
+
+
+def test_perf_page_surfaces_postings_overflow_alert(srv):
+    """build.postings_overflow must surface as a shard-split alert on
+    /admin/perf (HTML + json) — the operator sees the counter before
+    the overflowing node boot-loops on the build ValueError."""
+    from open_source_search_engine_tpu.utils.stats import g_stats
+    js = json.loads(_get(srv, "/admin/perf?format=json").read())
+    assert js["alerts"] == []
+    html = _get(srv, "/admin/perf").read().decode()
+    assert "shard_split_needed" not in html
+
+    g_stats.count("build.postings_overflow")
+    try:
+        js = json.loads(_get(srv, "/admin/perf?format=json").read())
+        assert len(js["alerts"]) == 1
+        a = js["alerts"][0]
+        assert a["name"] == "shard_split_needed"
+        assert a["count"] >= 1
+        assert "split the collection" in a["hint"]
+        html = _get(srv, "/admin/perf").read().decode()
+        assert "shard_split_needed" in html
+        assert "split the collection" in html
+    finally:
+        with g_stats._lock:
+            g_stats.counters.pop("build.postings_overflow", None)

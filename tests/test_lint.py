@@ -244,6 +244,30 @@ class TestSeededRegressions:
         # tests construct loops directly against fakes — out of scope
         assert osselint.check_source(src, "tests/test_resident.py") == []
 
+    def test_host_sort_in_ingest_plane_is_caught(self):
+        # the pre-PR-16 shape: _build_base's merge/docidx ran as host
+        # numpy orderings (np.unique + argsort over the whole corpus) —
+        # exactly the O(corpus) CPU stage the device ingest plane
+        # removed. Re-introducing one in devbuild.py must fire.
+        src = ("import numpy as np\n"
+               "def docidx_of(docids):\n"
+               "    uniq = np.unique(docids)\n"
+               "    return np.searchsorted(uniq, docids)\n"
+               "def order(keys):\n"
+               "    return sorted(keys)\n")
+        found = osselint.check_source(
+            src, "open_source_search_engine_tpu/build/devbuild.py")
+        assert [f.rule for f in found] == ["host-sort", "host-sort"]
+        # the host oracle pipeline keeps its numpy orderings
+        assert osselint.check_source(
+            src, "open_source_search_engine_tpu/query/devindex.py") == []
+        # and the device orderings the fence steers toward stay clean
+        dev = ("import jax.numpy as jnp\n"
+               "def order(keys):\n"
+               "    return jnp.argsort(keys, stable=True)\n")
+        assert osselint.check_source(
+            dev, "open_source_search_engine_tpu/build/devbuild.py") == []
+
 
 class TestJitSeededRegressions:
     """The literal jit hazard shapes the PR 7 rules caught (or

@@ -15,7 +15,8 @@ python -m tools.osselint
 #    lives in tests/test_lint.py)
 python -m tools.osselint tests/lint_fixtures/clean_parallel.py \
     tests/lint_fixtures/clean_jit.py tests/lint_fixtures/clean_mesh.py \
-    tests/lint_fixtures/clean_tenancy.py
+    tests/lint_fixtures/clean_tenancy.py \
+    tests/lint_fixtures/clean_devbuild.py
 for f in tests/lint_fixtures/violations_*.py; do
     if python -m tools.osselint "$f" > /dev/null 2>&1; then
         echo "check.sh: $f produced no findings" >&2
@@ -80,5 +81,15 @@ BENCH_TENANTS=1 BENCH_TENANTS_COLLS=64 BENCH_TENANTS_HOT=32 \
 BENCH_MESH=1 BENCH_MESH_SHARDS=1,4 BENCH_MESH_DPS=80 \
     BENCH_MESH_QUERIES=32 BENCH_MESH_JIT_WAVES=24 \
     BENCH_MESH_FAILOVER_DOCS=60 \
+    JAX_PLATFORMS=cpu python bench.py
+
+# 9. ingest-plane smoke: a SMALL corpus through the device posting
+#    sort/dedup/pack pipeline — gates bitwise parity against the host
+#    oracle (columns, dir tables, f16 impacts), a cold device rebuild
+#    under a CI-box bound, and zero compiles/retraces across repeated
+#    same-bucket delta folds (bench.py main_build docstring; the
+#    100k-doc < 60 s shape runs nightly via BENCH_BUILD=1 defaults)
+BENCH_BUILD=1 BENCH_BUILD_DOCS=400 BENCH_BUILD_PARITY_DOCS=200 \
+    BENCH_BUILD_REBUILD_S=300 \
     JAX_PLATFORMS=cpu python bench.py
 echo "check.sh: OK"

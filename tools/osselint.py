@@ -593,7 +593,48 @@ def rule_device_sync(ctx: Ctx) -> list[Finding]:
 
 def _device_scope(rel: str) -> bool:
     return _in_pkg(rel) and rel not in (
-        f"{PKG}/query/devindex.py", f"{PKG}/query/scorer.py")
+        f"{PKG}/query/devindex.py", f"{PKG}/query/scorer.py",
+        f"{PKG}/build/devbuild.py")
+
+
+def _devbuild_scope(rel: str) -> bool:
+    return rel == f"{PKG}/build/devbuild.py"
+
+
+#: the numpy orderings whose presence means a posting stage fell back
+#: to the host (each has a jnp twin the ingest plane must use instead)
+_HOST_SORTS = {"sort", "unique", "argsort", "lexsort"}
+
+
+def rule_host_sort(ctx: Ctx) -> list[Finding]:
+    """``build/devbuild.py`` is the device ingest plane: the posting
+    sort/dedup/pack pipeline stays on-chip by contract (mirroring the
+    device-sync fence on ``query/resident.py``). A ``np.sort`` /
+    ``np.unique`` / ``np.argsort`` / ``sorted`` call there means a
+    stage quietly fell back to host ordering — exactly the O(corpus)
+    CPU work the plane exists to remove. Host ordering belongs to the
+    oracle pipeline in ``query/devindex.py``."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        if not name:
+            continue
+        parts = name.split(".")
+        if parts[0] in ("np", "numpy") and parts[-1] in _HOST_SORTS:
+            hit = name
+        elif name == "sorted":
+            hit = "sorted"
+        else:
+            continue
+        out.append(Finding(
+            ctx.rel, node.lineno, "host-sort",
+            f"{hit} in the device ingest plane — posting "
+            "sort/dedup/pack must stay on-chip (jnp.lexsort / "
+            "segmented scans); host ordering belongs to the oracle "
+            "pipeline in query/devindex.py"))
+    return out
 
 
 #: cross-chip collectives — the ICI traffic primitives. One module owns
@@ -638,7 +679,7 @@ def _mesh_collective_scope(rel: str) -> bool:
 #: path's replicated-output materialization in sharded.py
 _JIT_TRANSFER_BOUNDARY = (
     f"{PKG}/query/devindex.py", f"{PKG}/query/scorer.py",
-    f"{PKG}/parallel/sharded.py")
+    f"{PKG}/parallel/sharded.py", f"{PKG}/build/devbuild.py")
 
 _ARRAYISH_CALLS = {"np.array", "np.asarray", "numpy.array",
                    "numpy.asarray", "jnp.array", "jnp.asarray",
@@ -1181,6 +1222,7 @@ RULES = [
     ("thread-spawn", _thread_scope, rule_thread_spawn),
     ("locked-global", _locked_global_scope, rule_locked_global),
     ("device-sync", _device_scope, rule_device_sync),
+    ("host-sort", _devbuild_scope, rule_host_sort),
     ("mesh-collective", _mesh_collective_scope, rule_mesh_collective),
     ("jit-unstable-static", _in_pkg, rule_jit_unstable_static),
     ("jit-in-body", _jit_body_scope, rule_jit_in_body),
