@@ -111,10 +111,17 @@ def _backend_record() -> dict:
     try:
         import jax
         backend = jax.default_backend()
+        rec = {"backend": str(backend),
+               "device_measured": str(backend) == "tpu"}
     except Exception:  # noqa: BLE001 — backend never initialized
-        return {"backend": "none", "device_measured": False}
-    return {"backend": str(backend),
-            "device_measured": str(backend) == "tpu"}
+        rec = {"backend": "none", "device_measured": False}
+    try:  # doctor stamp: jax version, device kind/count, topology,
+        # memory_stats (null on CPU) — the r05 post-mortem's ask
+        from tools import devdoctor
+        rec.update(devdoctor.stamp())
+    except Exception:  # noqa: BLE001 — stamp must never break a leg
+        pass
+    return rec
 
 
 def _emit_stale_curve(reason: str) -> None:
@@ -874,6 +881,133 @@ def main_jit() -> None:
     }))
     if not ok:
         sys.exit(1)
+
+
+def main_devobs() -> dict:
+    """Device-telemetry gate (BENCH_DEVOBS=1): the devwatch plane must
+    be free (<2% steady-state overhead), honest (HBM ledger agrees
+    with the index's own accounting, and with ``memory_stats()`` on a
+    real backend), and complete (a roofline entry for every dispatched
+    shape bucket, the doctor stamp on the JSON line). Median
+    per-ticket latency is compared devwatch-off vs devwatch-on; the
+    one-time ``cost_analysis()`` per bucket is paid in an untimed
+    populate pass, so the gate measures the steady-state fast path.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from open_source_search_engine_tpu.build import docproc
+    from open_source_search_engine_tpu.index.collection import Collection
+    from open_source_search_engine_tpu.query import engine
+    from open_source_search_engine_tpu.query.engine import (
+        get_device_index, get_resident_loop)
+    from open_source_search_engine_tpu.utils import devwatch
+
+    devwatch.disable()
+    devwatch.reset()
+    n_docs = int(os.environ.get("BENCH_DEVOBS_DOCS", "160"))
+    n_waves = int(os.environ.get("BENCH_DEVOBS_WAVES", "40"))
+    tol = float(os.environ.get("BENCH_DEVOBS_TOL", "0.02"))
+
+    bdir = tempfile.mkdtemp(prefix="osse_bench_devobs_")
+    coll = Collection("devobs", bdir)
+    docproc.index_batch(coll, [
+        (f"http://devobs.test/d{d}",
+         f"<html><body><p>telemetry bench words token{d % 23} "
+         f"extra{d % 7} rare{d % 61}</p></body></html>")
+        for d in range(n_docs)])
+    di = get_device_index(coll)
+    qs = [f"bench token{k % 23}" if k % 3 else f"words rare{k % 61}"
+          for k in range(12)]
+    qs += [f"telemetry extra{k % 7} token{k % 23}" for k in range(6)]
+    plans = [engine._compile_cached(q, 0) for q in qs]
+    loop = get_resident_loop(coll)
+
+    for p in plans:  # warm every shape bucket, devwatch off
+        loop.submit([p], topk=32).wait(timeout=120)
+
+    devwatch.enable()
+    # one extra doc + a refresh through the production path populates
+    # the ledger; one untimed pass per plan pays the one-time
+    # cost_analysis() per bucket
+    docproc.index_batch(coll, [("http://devobs.test/extra",
+                                "<html><body><p>telemetry bench words "
+                                "token1 extra1</p></body></html>")])
+    for p in plans:
+        loop.submit([p], topk=32).wait(timeout=120)
+
+    # interleave off/on waves so host-timing drift (frequency scaling,
+    # GC, page-cache warming) lands equally on both sides — a
+    # sequential A-then-B layout folds the drift into the overhead
+    off: list = []
+    on: list = []
+    for k in range(2 * n_waves):
+        if k % 2:
+            devwatch.enable()
+        else:
+            devwatch.disable()
+        t0 = time.perf_counter()
+        loop.submit([plans[k % len(plans)]], topk=32).wait(timeout=120)
+        (on if k % 2 else off).append(time.perf_counter() - t0)
+    devwatch.enable()
+    off.sort()
+    on.sort()
+    median_off = off[len(off) // 2]
+    median_on = on[len(on) // 2]
+    overhead = median_on / median_off - 1 if median_off > 0 else 0.0
+
+    snap = devwatch.snapshot()
+    ledger_bytes = devwatch.collection_bytes(coll.name)
+    resident = int(di.resident_bytes())
+    ledger_ok = ledger_bytes == resident
+
+    # memory_stats gate: only binding where the backend reports it
+    recon = snap.get("reconcile") or {}
+    mem_ok, mem_checked = True, False
+    for drec in (recon.get("devices") or []):
+        in_use = drec.get("bytes_in_use")
+        if in_use:
+            mem_checked = True
+            delta = abs(in_use - snap["total_bytes"])
+            mem_ok = mem_ok and delta / in_use <= 0.05
+
+    roofs = snap.get("rooflines") or []
+    roof_ok = bool(roofs) and all(
+        r.get("dispatches", 0) >= 1 and r.get("flops") is not None
+        and r.get("bytes") is not None for r in roofs)
+
+    br = _backend_record()
+    stamp_ok = all(k in br for k in
+                   ("doctor", "jax_version", "device_kind",
+                    "device_count", "memory_stats"))
+
+    ok = (overhead < tol and ledger_ok and mem_ok and roof_ok
+          and stamp_ok)
+    rep = {
+        **br,
+        "metric": "devwatch_overhead",
+        "value": round(overhead * 100, 3), "unit": "percent",
+        "waves": n_waves,
+        "p50_off_ms": round(1000 * median_off, 3),
+        "p50_on_ms": round(1000 * median_on, 3),
+        "ledger_bytes": ledger_bytes,
+        "resident_bytes": resident,
+        "ledger_ok": ledger_ok,
+        "memory_stats_checked": mem_checked,
+        "memory_stats_ok": mem_ok,
+        "rooflines": len(roofs),
+        "roofline_ok": roof_ok,
+        "stamp_ok": stamp_ok,
+        "wave_records": len(snap.get("waves") or []),
+        "ok": ok,
+        "budget": f"devwatch-on overhead < {tol:.0%}; ledger == "
+                  "resident_bytes; memory_stats within 5% where "
+                  "reported; roofline per dispatched bucket; doctor "
+                  "stamp present",
+    }
+    print(json.dumps(rep))
+    devwatch.disable()
+    devwatch.reset()
+    shutil.rmtree(bdir, ignore_errors=True)
+    return rep
 
 
 def _build_cols_mismatch(host, dev) -> list:
@@ -2361,6 +2495,14 @@ def main_tenants() -> dict:
 
 
 if __name__ == "__main__":
+    if not os.environ.get("BENCH_MESH_CHILD"):
+        # backend preflight: loud, actionable diagnosis on stderr for
+        # the r05 init-failure class; never blocks a CPU run
+        try:
+            from tools import devdoctor
+            devdoctor.preflight()
+        except Exception:  # noqa: BLE001 — preflight must not wedge
+            pass
     if os.environ.get("BENCH_SOAK"):
         sys.exit(0 if main_soak()["ok"] else 1)
     elif os.environ.get("BENCH_MESH_CHILD"):
@@ -2387,5 +2529,7 @@ if __name__ == "__main__":
         sys.exit(0 if main_fleet()["ok"] else 1)
     elif os.environ.get("BENCH_TENANTS"):
         sys.exit(0 if main_tenants()["ok"] else 1)
+    elif os.environ.get("BENCH_DEVOBS"):
+        sys.exit(0 if main_devobs()["ok"] else 1)
     else:
         main()

@@ -52,7 +52,7 @@ import numpy as np
 from jax import lax
 
 from ..index import posdb
-from ..utils import jitwatch
+from ..utils import devwatch, jitwatch
 from ..utils.log import get_logger
 from ..utils.stats import g_stats
 from ..query import weights
@@ -63,6 +63,7 @@ log = get_logger("devbuild")
 # the ingest plane is a jit entry point of its own (bench BENCH_BUILD
 # imports it before any query module) — same opt-in as devindex
 jitwatch.maybe_enable()
+devwatch.maybe_enable()
 
 #: column bucket quantum — mirrors devindex.COL_QUANTUM (kept numeric
 #: here: devindex imports this module, not the other way round)
@@ -484,6 +485,11 @@ def build_base(run_keys: list[np.ndarray], put,
     bd_hi = np.asarray(_bslice(out["bd_hi"], n_docs, quantum))
     h_doc = np.asarray(_bslice(out["doc_col"], n_pairs, quantum))
     g_stats.count("build.device_base")
+    if devwatch.enabled():
+        # transient ingest staging in the HBM ledger — the consumer
+        # (devindex refresh) drops the slice once fit() folded the
+        # columns into the resident plane
+        devwatch.note_columns("(ingest)", "build", out)
     return DeviceBuild(
         n=nk, n_pairs=n_pairs, dir_termids=dirs["dir_termids"],
         df=dirs["df"], dir_dstart=dirs["dir_dstart"],
@@ -515,6 +521,8 @@ def build_delta(fp_: dict, docidx: np.ndarray, put,
         stage(fp_["langid"]), np.int32(m))
     nk, n_pairs, dirs = _fetch_dir(out, out["counters"], quantum)
     g_stats.count("build.device_delta")
+    if devwatch.enabled():
+        devwatch.note_columns("(ingest)", "build", out)
     return DeviceBuild(
         n=nk, n_pairs=n_pairs, dir_termids=dirs["dir_termids"],
         df=dirs["df"], dir_dstart=dirs["dir_dstart"],

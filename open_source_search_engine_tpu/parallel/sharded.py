@@ -25,6 +25,7 @@ dummy block (the reference's empty Msg39 reply).
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from functools import partial
 from pathlib import Path
@@ -46,6 +47,7 @@ from ..query.packer import (MAX_POSITIONS, PackedQuery, PreparedQuery,
                             _bucket, _pad1, group_flags, pack_pass,
                             prepare_query)
 from ..query.scorer import merge_dedup_topk, score_core
+from ..utils import devwatch
 from ..utils.log import get_logger
 from ..utils.membudget import g_membudget
 from .hostmap import SHARD_AXIS, HostMap, make_mesh
@@ -818,6 +820,7 @@ class _MeshWave:
     max_out: int
     use_filter: bool
     use_sort: bool
+    stage_key: str = ""   # devwatch mesh_stage ledger column ("" = off)
 
 
 @dataclass
@@ -990,6 +993,17 @@ class MeshServeIndex:
         wave = _MeshWave(out=None, args=sharded_args, qidx=list(qidx),
                          local_k=local_k, out_k=out_k, max_out=max_out,
                          use_filter=use_f, use_sort=use_s)
+        if devwatch.enabled():
+            # transient mesh staging in the HBM ledger: the sharded
+            # operands live on-chip from dispatch until collect drops
+            # the slot (slot keys cycle mod 8 — bounded vocabulary,
+            # and in-flight waves never exceed the loop DEPTH)
+            self._stage_seq = getattr(self, "_stage_seq", 0) + 1
+            wave.stage_key = f"wave{self._stage_seq % 8}"
+            devwatch.note_buffer(
+                getattr(self.sc, "name", "mesh"), "mesh_stage",
+                wave.stage_key,
+                int(sum(a.nbytes for a in args.values())))
         wave.out = self._dispatch(wave)
         return wave
 
@@ -1021,10 +1035,15 @@ class MeshServeIndex:
                 for qi in wave.qidx:
                     results[qi] = empty
                 continue
+            device_s = 0.0
+            redispatches = 0
             while True:
                 # the mesh wave's ONE blessed host sync (the collect
                 # boundary — jitwatch BOUNDARY_SITES lists this file)
+                t_fetch = time.perf_counter()
                 out = np.asarray(jax.device_get(wave.out))  # osselint: ignore[device-sync] — wave collect boundary
+                t_got = time.perf_counter()
+                device_s += t_got - t_fetch
                 K = wave.out_k
                 need_more = False
                 for row, qi in zip(out, wave.qidx):
@@ -1039,6 +1058,17 @@ class MeshServeIndex:
                 wave.out_k = min(_bucket(wave.out_k * 4, 64),
                                  wave.max_out)
                 wave.out = self._dispatch(wave)
+                redispatches += 1
+            if devwatch.enabled():
+                devwatch.note_round(
+                    coll=getattr(self.sc, "name", "mesh"),
+                    kinds="mesh", waves=1, device_s=device_s,
+                    bytes_out=int(out.nbytes), out_k=wave.out_k,
+                    escalations=redispatches)
+                if wave.stage_key:
+                    devwatch.drop_buffer(
+                        getattr(self.sc, "name", "mesh"),
+                        "mesh_stage", wave.stage_key)
             for row, qi in zip(out, wave.qidx):
                 total = int(row[0])
                 n_kept = int(row[1])

@@ -124,7 +124,8 @@ def _use_jit() -> bool:
 def _trip(route: str, msg: str) -> None:
     g_stats.count("devcheck.trip")
     if route:
-        g_stats.count(f"devcheck.trip.{route}")
+        # route ∈ {f1, f2, fd} — bounded, not a cardinality risk
+        g_stats.count(f"devcheck.trip.{route}")  # osselint: ignore[stats-cardinality]
     log.error("devcheck TRIP [%s]: %s", route or "-", msg)
     raise DeviceCheckError(f"[{route or 'device'}] {msg}")
 

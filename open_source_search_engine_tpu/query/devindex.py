@@ -100,7 +100,7 @@ from ..index import clusterdb as clusterdb_mod
 from ..index import posdb
 from ..index.collection import Collection
 from ..index.rdblite import merge_batches
-from ..utils import jitwatch, trace
+from ..utils import devwatch, jitwatch, trace
 from ..utils.log import get_logger
 from ..utils.stats import g_stats
 from . import devcheck, weights
@@ -116,6 +116,23 @@ log = get_logger("devindex")
 # watcher on here means OSSE_JITWATCH=1 covers tests, bench, and serve
 # without each entry point opting in
 jitwatch.maybe_enable()
+devwatch.maybe_enable()
+
+#: bounded wave-histogram vocabulary. The per-round wave stat used to
+#: be built with an f-string over (kind-combo, wave count) — one
+#: histogram per distinct count, unbounded cardinality (the osselint
+#: ``stats-cardinality`` rule now bans that spelling). This table IS
+#: the bound: kind combos × count buckets, fixed at import.
+_WAVE_NBUCKETS = (1, 2, 4, 8)
+_WAVE_STAT = {(k, n): f"devindex.wave_{k}_n{n}"
+              for k in ("f1", "f2", "f1+f2") for n in _WAVE_NBUCKETS}
+
+
+def _wave_nbucket(n: int) -> int:
+    for b in _WAVE_NBUCKETS:
+        if n <= b:
+            return b
+    return _WAVE_NBUCKETS[-1]
 
 #: shape-bucket floors (distinct shape tuples = one XLA compile each)
 RD_FLOOR = 4      # dense rows
@@ -557,6 +574,14 @@ class DeviceIndex:
         else:
             self._build_delta()
         self._built_version = rdb.version
+        if devwatch.enabled():
+            # one registration point covers base, delta and regrow —
+            # every rebuild path funnels through here with the final
+            # column bindings; the devbuild staging slice is consumed
+            # by now, so release it in the same breath
+            devwatch.drop("(ingest)", "build")
+            devwatch.note_columns(self.coll.name, "devindex",
+                                  self._column_map())
         return True
 
     #: bump when any derived-column computation changes (cache schema)
@@ -1098,17 +1123,25 @@ class DeviceIndex:
     def n_docs(self) -> int:
         return len(self.all_docids)
 
+    def _column_map(self) -> dict:
+        """The resident device columns by name — the HBM ledger's
+        (collection, plane, column) unit and the residency-gate byte
+        source; extend here when a rebuild path grows a column."""
+        return {"payload": self.d_payload, "docc": self.d_docc,
+                "doc": self.d_doc, "imp": self.d_imp,
+                "rs": self.d_rs, "cnt": self.d_cnt,
+                "dense_imp": self.d_dense_imp,
+                "dense_rs": self.d_dense_rs,
+                "dense_cnt": self.d_dense_cnt, "cube": self.d_cube,
+                "siterank": self.d_siterank,
+                "doclang": self.d_doclang, "dead": self.d_dead}
+
     def resident_bytes(self) -> int:
         """Total device bytes this index holds resident — the number
         the background-rebuild double-residency gate reasons about."""
         import numpy as _np
-        return sum(
-            int(_np.prod(a.shape)) * a.dtype.itemsize
-            for a in (self.d_payload, self.d_docc,
-                      self.d_doc, self.d_imp, self.d_rs, self.d_cnt,
-                      self.d_dense_imp, self.d_dense_rs,
-                      self.d_dense_cnt, self.d_cube,
-                      self.d_siterank, self.d_doclang, self.d_dead))
+        return sum(int(_np.prod(a.shape)) * a.dtype.itemsize
+                   for a in self._column_map().values())
 
     def _docid_pos(self, docids_arr: np.ndarray) -> tuple[np.ndarray,
                                                           np.ndarray]:
@@ -1690,16 +1723,17 @@ class DeviceIndex:
             outs = jax.device_get([w[4] for w in waves])
             t_got = time.perf_counter()
             kinds = "+".join(sorted({w[0] for w in waves}))
-            trace.record(f"devindex.wave_{kinds}_n{len(waves)}",
-                         t_fetch, t_got)
+            stat = _WAVE_STAT.get((kinds, _wave_nbucket(len(waves))))
+            if stat is not None:
+                trace.record(stat, t_fetch, t_got)
+            fetched = int(sum(np.asarray(o).nbytes for o in outs))
             # device-time attribution: device_get blocks until every
             # issued wave completes (the block_until_ready delta), so
             # this interval IS the device time of the round, and the
             # fetched buffers are the bytes moved device→host
             trace.record(
                 "devindex.device", t_fetch, t_got,
-                kinds=kinds, waves=len(waves),
-                bytes=int(sum(np.asarray(o).nbytes for o in outs)))
+                kinds=kinds, waves=len(waves), bytes=fetched)
             f1_next: list[int] = []
             f2_next: list[int] = []
             for (kind, kappa, k2g, idxs, _), out in zip(waves, outs):
@@ -1737,6 +1771,19 @@ class DeviceIndex:
                     self._emit(results, i, nm, idx, scores)
             if f1_next or f2_next:
                 self.escalations += len(f1_next) + len(f2_next)
+            if devwatch.enabled():
+                # flight-recorder round detail: measured device time +
+                # fetched bytes next to the modeled F1 wave bytes, so
+                # the /admin/device waterfall shows model vs reality
+                devwatch.note_round(
+                    coll=self.coll.name, kinds=kinds,
+                    waves=len(waves), device_s=t_got - t_fetch,
+                    bytes_out=fetched,
+                    modeled_f1_bytes=int(sum(
+                        self.wave_bytes_per_query(
+                            [plans[i] for i in w[3]]) * len(w[3])
+                        for w in waves if w[0] == "f1")),
+                    escalations=len(f1_next) + len(f2_next))
             f2_nsel = min(f2_nsel * 4, self.D_cap)
             waves = self._issue_waves(
                 plans, f1_next, f2_next, pending.topk, pending.k2v,
@@ -2009,6 +2056,20 @@ class DeviceIndex:
         total += V * D * imp
         return total / B
 
+    def _costed(self, name: str, bucket: tuple, modeled_bytes,
+                fn, *args, **statics):
+        """Dispatch a jitted kernel, roofline-attributing its
+        (kernel, shape-bucket) on first sight: devwatch pulls
+        flops/bytes from ``lower().compile().cost_analysis()`` once
+        per bucket (a dict hit afterwards), so every warmed shape has
+        a bandwidth/compute verdict next to the modeled wave bytes."""
+        if devwatch.enabled():
+            devwatch.note_cost(
+                name, bucket,
+                lambda: fn.lower(*args, **statics).compile(),
+                modeled_bytes=modeled_bytes)
+        return fn(*args, **statics)
+
     def _run_batch(self, plans: list[ResidentPlan], kappa: int, k2: int):
         # pinned bucket ladders — every (Rd, Rs, κ, B) combination that
         # everyday queries can hit is finite and enumerable, so warm()
@@ -2095,7 +2156,11 @@ class DeviceIndex:
         # — the caller fetches every wave's output in ONE device_get
         # (each separate blocking fetch costs a full ~100 ms tunnel RTT)
         d_filter, d_sort, uf, us = self._filter_sort_cols(plans[0])
-        return _two_phase(
+        modeled = self.wave_bytes_per_query(plans) * B \
+            if devwatch.enabled() else None
+        return self._costed(
+            "devindex._two_phase", (B, Rd, Rs, Lsp, kappa, k2),
+            modeled, _two_phase,
             self.d_payload, self.d_doc, self.d_imp, self.d_rs,
             self.d_cnt, self.d_dense_imp, self.d_dense_rs,
             self.d_dense_cnt,
@@ -2152,7 +2217,10 @@ class DeviceIndex:
         log.debug("f2 wave: B=%d Rc=%d Rp=%d Lp=%d k2=%d n_sel=%d",
                   B, Rc, Rp, Lp, k2, n_sel)
         d_filter, d_sort, uf, us = self._filter_sort_cols(plans[0])
-        return _full_cube(
+        return self._costed(
+            "devindex._full_cube",
+            (B, Rc, Rp, Lp, k2, min(n_sel, self.D_cap)),
+            None, _full_cube,
             self.d_payload, self.d_docc, self.d_cube,
             self.d_dense_cnt, self.d_siterank, self.d_doclang,
             self.d_dead, np.int32(self.n_docs), d_filter, d_sort,
@@ -2222,7 +2290,10 @@ class DeviceIndex:
             # _direct_cube itself is jitted so checkify can't run there
             d_cube = devcheck.apply_cube_fault(d_cube)
             devcheck.check_cube(d_cube, route="fd")
-        return _direct_cube(
+        return self._costed(
+            "devindex._direct_cube",
+            (B, T, Rp, Lp, k2, min(n_sel, self.D_cap)),
+            None, _direct_cube,
             d_cube, self.d_payload, self.d_docc,
             self.d_siterank, self.d_doclang, self.d_dead,
             np.int32(self.n_docs), d_filter, d_sort, cs, sy, *args,

@@ -47,6 +47,7 @@ from collections import deque
 from typing import Callable
 
 from ..utils import deadline as deadline_mod
+from ..utils import devwatch
 from ..utils import threads as _threads
 from ..utils.chaos import g_chaos
 from ..utils.log import get_logger
@@ -113,14 +114,17 @@ class Ticket:
 
 
 class _Wave:
-    """An issued-but-uncollected wave and the tickets riding it."""
+    """An issued-but-uncollected wave and the tickets riding it.
+    ``obs`` is the devwatch flight-recorder record opened at issue
+    (None when the telemetry plane is off)."""
 
-    __slots__ = ("pending", "tickets", "di")
+    __slots__ = ("pending", "tickets", "di", "obs")
 
-    def __init__(self, pending, tickets, di):
+    def __init__(self, pending, tickets, di, obs=None):
         self.pending = pending
         self.tickets = tickets
         self.di = di
+        self.obs = obs
 
 
 class ResidentLoop:
@@ -278,18 +282,24 @@ class ResidentLoop:
             return
         if g_chaos.enabled:
             g_chaos.resident_fault("issue")
+        obs = devwatch.wave_begin("resident", coll=self.name,
+                                  tickets=len(batch),
+                                  queue=len(self._queue))
         try:
             di = self._index_for_issue()
             plans = [p for t in batch for p in t.plans]
             pending = di.issue_batch(plans, topk=batch[0].topk,
                                      lang=batch[0].lang)
+            devwatch.wave_issued(obs, plans=len(plans),
+                                 generation=di._built_version)
             for t in batch:
                 t.di = di
                 t.generation = di._built_version
-            self._inflight.append(_Wave(pending, batch, di))
+            self._inflight.append(_Wave(pending, batch, di, obs))
             self.waves_issued += 1
             g_stats.count("resident.issue")
         except BaseException as exc:  # noqa: BLE001
+            devwatch.wave_end(obs, error=type(exc).__name__)
             for t in batch:
                 t._fail(exc)
 
@@ -298,11 +308,14 @@ class ResidentLoop:
         try:
             if g_chaos.enabled:
                 g_chaos.resident_fault("collect")
+            devwatch.wave_collect(wave.obs)
             results = wave.di.collect_batch(wave.pending)
             off = 0
             for t in wave.tickets:
                 t._resolve(results[off:off + len(t.plans)])
                 off += len(t.plans)
+            devwatch.wave_end(wave.obs)
         except BaseException as exc:  # noqa: BLE001
+            devwatch.wave_end(wave.obs, error=type(exc).__name__)
             for t in wave.tickets:
                 t._fail(exc)

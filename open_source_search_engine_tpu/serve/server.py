@@ -626,6 +626,10 @@ class SearchHTTPServer:
             return self._page_parms(query)
         if path == "/admin/jit":
             return self._page_jit(query)
+        if path == "/admin/hbm":
+            return self._page_hbm(query)
+        if path == "/admin/device":
+            return self._page_device(query)
         if path == "/admin/admission":
             return self._page_admission(query)
         if path == "/admin/tenants":
@@ -1061,8 +1065,8 @@ class SearchHTTPServer:
         links = "".join(
             f'<li><a href="/admin/{p}{sfx}">{p}</a></li>'
             for p in ("stats", "hosts", "perf", "mem", "transport",
-                      "cache", "traces", "parms", "jit", "admission",
-                      "tenants", "profiler",
+                      "cache", "traces", "parms", "jit", "hbm",
+                      "device", "admission", "tenants", "profiler",
                       "graph")) + '<li><a href="/metrics">metrics</a></li>'
         rows = "".join(f"<tr><td>{k}</td><td>{v}</td></tr>"
                        for k, v in self.stats.items())
@@ -1241,6 +1245,16 @@ class SearchHTTPServer:
                          "collection across more shards before the "
                          "node boot-loops"),
             })
+        # HBM headroom row from the device telemetry plane: ledger
+        # total next to what memory_stats() reports (nulls on a CPU
+        # backend / with devwatch off — the row still renders)
+        from ..utils import devwatch
+        rec = devwatch.reconcile()
+        dev0 = rec["devices"][0] if rec["devices"] else {}
+        hbm = {"enabled": devwatch.enabled(),
+               "ledger_bytes": rec["ledger_bytes"],
+               "bytes_in_use": dev0.get("bytes_in_use"),
+               "headroom": dev0.get("headroom")}
         if query.get("format") == "json":
             body = {
                 "hosts": {
@@ -1261,11 +1275,13 @@ class SearchHTTPServer:
                 },
                 "slo": slo_status,
                 "alerts": alerts,
+                "hbm": hbm,
             }
             return 200, json.dumps(body), "application/json"
 
         pwd = query.get("pwd", "")
         sfx = f"&pwd={urllib.parse.quote(pwd)}" if pwd else ""
+        lsfx = f"?pwd={urllib.parse.quote(pwd)}" if pwd else ""
         addrs = sorted(hosts)
         per_host = {
             a: {} if hosts[a] is None else {
@@ -1337,6 +1353,14 @@ class SearchHTTPServer:
             f"<p>{up}/{len(hosts)} hosts scraped &middot; "
             f'<a href="/admin/perf?format=json{sfx}">json</a> &middot; '
             f'<a href="/metrics">metrics</a></p>'
+            f"<p>HBM: ledger {hbm['ledger_bytes'] >> 20} MB &middot; "
+            f"in use {hbm['bytes_in_use'] if hbm['bytes_in_use'] is not None else 'n/a'}"
+            f" &middot; headroom "
+            f"{hbm['headroom'] if hbm['headroom'] is not None else 'n/a'}"
+            f" &middot; devwatch "
+            f"{'on' if hbm['enabled'] else 'off'} &middot; "
+            f'<a href="/admin/hbm{lsfx}">hbm</a> '
+            f'<a href="/admin/device{lsfx}">device</a></p>'
             f"<p>{spark('qps', '#1f77b4')}<br>"
             f"{spark('p50_ms', '#d62728')}</p>"
             f"<h2>latencies (ms)</h2>"
@@ -1400,6 +1424,16 @@ class SearchHTTPServer:
         lines.append("# TYPE osse_gauge gauge")
         lines.extend(f'osse_gauge{{name="{k}"}} {v:g}'
                      for k, v in sorted(fleet["gauges"].items()))
+        # per-(collection, plane) device residency from the HBM
+        # ledger (OSSE_DEVWATCH=1; empty rows when off) — the tenant
+        # plane's byte-bounded residency, scrape-visible fleet-wide
+        from ..utils import devwatch
+        lines.append("# TYPE osse_hbm_bytes gauge")
+        for c, planes in sorted(
+                devwatch.g_devwatch.ledger_snapshot().items()):
+            for p, cols in sorted(planes.items()):
+                lines.append(f'osse_hbm_bytes{{collection="{c}",'
+                             f'plane="{p}"}} {sum(cols.values())}')
         lines.append(f"osse_hosts_scraped "
                      f"{sum(1 for w in hosts.values() if w is not None)}")
         return "\n".join(lines) + "\n"
@@ -1512,6 +1546,117 @@ class SearchHTTPServer:
             "<table border=1><tr><th>kind</th><th>fn</th><th>site</th>"
             "<th>count</th><th>bytes</th><th>boundary</th>"
             f"<th>detail</th></tr>{rows}</table>"
+            "</body></html>"), "text/html"
+
+    def _page_hbm(self, query: dict) -> tuple[int, str, str]:
+        """HBM ledger (OSSE_DEVWATCH=1): every registered device
+        buffer by (collection, plane, column), plane totals, and the
+        reconciliation against ``device.memory_stats()`` — live bytes
+        the ledger cannot name are allocator slack + unregistered
+        temporaries (the fragmentation column). ``?format=json``
+        returns the raw ledger."""
+        from ..utils import devwatch
+        snap = devwatch.snapshot()
+        body = {k: snap[k] for k in ("enabled", "ledger", "planes",
+                                     "collections", "total_bytes",
+                                     "reconcile")}
+        if query.get("format") == "json":
+            return 200, json.dumps(body), "application/json"
+        rows = "".join(
+            f"<tr><td>{c}</td><td>{p}</td><td>{col}</td>"
+            f"<td>{n}</td></tr>"
+            for c, planes in sorted(snap["ledger"].items())
+            for p, cols in sorted(planes.items())
+            for col, n in sorted(cols.items())) \
+            or "<tr><td colspan=4>none</td></tr>"
+        dev_rows = "".join(
+            f"<tr><td>{d['device']}</td><td>{d['kind']}</td>"
+            f"<td>{d['bytes_in_use']}</td>"
+            f"<td>{d['peak_bytes_in_use']}</td>"
+            f"<td>{d['headroom']}</td>"
+            f"<td>{d['fragmentation']}</td></tr>"
+            for d in snap["reconcile"]["devices"]) \
+            or "<tr><td colspan=6>no devices</td></tr>"
+        planes = " &middot; ".join(
+            f"{p}: {n >> 20} MB"
+            for p, n in sorted(snap["planes"].items())) or "empty"
+        return 200, (
+            "<html><head><title>gb hbm</title></head><body>"
+            "<h1>HBM ledger</h1>"
+            f"<p>devwatch {'enabled' if snap['enabled'] else 'DISABLED'}"
+            f" &middot; ledger {snap['total_bytes'] >> 20} MB"
+            f" &middot; {planes}</p>"
+            "<table border=1><tr><th>collection</th><th>plane</th>"
+            f"<th>column</th><th>bytes</th></tr>{rows}</table>"
+            "<h2>memory_stats reconciliation</h2>"
+            "<table border=1><tr><th>device</th><th>kind</th>"
+            "<th>bytes_in_use</th><th>peak</th><th>headroom</th>"
+            f"<th>fragmentation</th></tr>{dev_rows}</table>"
+            "</body></html>"), "text/html"
+
+    def _page_device(self, query: dict) -> tuple[int, str, str]:
+        """Wave flight recorder + roofline attribution
+        (OSSE_DEVWATCH=1): the recorder ring's issue→wait→collect
+        waterfall with per-round escalations, and the per-(kernel,
+        shape-bucket) flops/bytes verdicts against the backend peaks.
+        ``?format=json`` returns the raw ring + cost table."""
+        from ..utils import devwatch
+        snap = devwatch.snapshot()
+        body = {k: snap[k] for k in ("enabled", "totals", "waves",
+                                     "rooflines", "peaks")}
+        if query.get("format") == "json":
+            return 200, json.dumps(body), "application/json"
+        waves = list(snap["waves"])[-64:]
+        scale = max((w["total_s"] for w in waves), default=0.0) or 1e-9
+
+        def bar(w):
+            return "".join(
+                f'<div style="display:inline-block;height:10px;'
+                f'width:{max(1, int(300 * w[f] / scale))}px;'
+                f'background:{c}"></div>'
+                for f, c in (("issue_s", "#4c78a8"),
+                             ("wait_s", "#eeca3b"),
+                             ("collect_s", "#e45756")))
+        rows = "".join(
+            f"<tr><td>{w['seq']}</td><td>{w['source']}</td>"
+            f"<td>{w.get('coll', '')}</td>"
+            f"<td>{w.get('plans', w.get('tickets', ''))}</td>"
+            f"<td>{w['total_s'] * 1000:.1f}</td><td>{bar(w)}</td>"
+            f"<td>{len(w['rounds'])}</td>"
+            f"<td>{sum(r.get('escalations', 0) for r in w['rounds'])}"
+            f"</td><td>{w['error'] or ''}</td></tr>"
+            for w in reversed(waves)) \
+            or "<tr><td colspan=9>none</td></tr>"
+        roof = "".join(
+            f"<tr><td>{e['kernel']}</td><td>{e['bucket']}</td>"
+            f"<td>{e['flops']:.3g}</td><td>{e['bytes']:.3g}</td>"
+            f"<td>{e['intensity']:.2f}</td><td>{e['ridge']:.2f}</td>"
+            f"<td>{e['verdict']}</td>"
+            f"<td>{e['modeled_bytes'] or ''}</td>"
+            f"<td>{e['dispatches']}</td></tr>"
+            for e in snap["rooflines"]) \
+            or "<tr><td colspan=9>none</td></tr>"
+        pk = snap["peaks"]
+        return 200, (
+            "<html><head><title>gb device</title></head><body>"
+            "<h1>device plane</h1>"
+            f"<p>devwatch {'enabled' if snap['enabled'] else 'DISABLED'}"
+            f" &middot; waves {snap['totals']['waves']}"
+            f" &middot; rounds {snap['totals']['rounds']}"
+            f" &middot; errors {snap['totals']['wave_errors']}"
+            f" &middot; peaks {pk['label']}"
+            f" ({pk['flops']:.3g} FLOP/s, {pk['bw']:.3g} B/s"
+            f"{', assumed' if pk['assumed'] else ''})</p>"
+            "<h2>wave waterfall (issue / wait / collect)</h2>"
+            "<table border=1><tr><th>seq</th><th>source</th>"
+            "<th>coll</th><th>plans</th><th>ms</th><th>split</th>"
+            "<th>rounds</th><th>escalations</th><th>error</th></tr>"
+            f"{rows}</table>"
+            "<h2>roofline per (kernel, shape bucket)</h2>"
+            "<table border=1><tr><th>kernel</th><th>bucket</th>"
+            "<th>flops</th><th>bytes</th><th>intensity</th>"
+            "<th>ridge</th><th>verdict</th><th>modeled bytes</th>"
+            f"<th>dispatches</th></tr>{roof}</table>"
             "</body></html>"), "text/html"
 
     #: waterfall bar palette — one color per host, assigned by hash so
@@ -1768,8 +1913,9 @@ class SearchHTTPServer:
     # --- lifecycle --------------------------------------------------------
 
     def start(self) -> None:
-        from ..utils import jitwatch
+        from ..utils import devwatch, jitwatch
         jitwatch.maybe_enable()
+        devwatch.maybe_enable()  # OSSE_DEVWATCH=1 arms the hbm plane
         chaos_mod.maybe_enable()  # OSSE_CHAOS=<seed> arms the plane
         # the ROADMAP traffic-plane objective, declared by default so
         # every server exports slo.query_p99.* from boot; operators

@@ -49,6 +49,7 @@ import time
 from collections import deque
 
 from ..utils import deadline as deadline_mod
+from ..utils import devwatch
 from ..utils import trace as trace_mod
 from ..utils.log import get_logger
 from ..utils.membudget import g_membudget
@@ -208,7 +209,12 @@ class ResidencyManager:
                 gen_fn=lambda: coll.posdb.version,
                 name=name)
             coll._resident_loop = loop  # back-compat introspection
-            nbytes = int(di.resident_bytes())
+            # the HBM ledger (when on) is the source of truth behind
+            # the membudget "device" label — it saw every column the
+            # refresh registered; resident_bytes() is the always-on
+            # fallback computing the same sum from shapes
+            nbytes = int(devwatch.collection_bytes(name)
+                         or di.resident_bytes())
             with self._lock:
                 t = self._tenants.get(name)
                 if t is None:
@@ -284,6 +290,7 @@ class ResidencyManager:
             coll._resident_loop = None
             coll._device_index = None  # device arrays GC → HBM freed
         g_membudget.set_gauge("device", f"di:{name}", 0)
+        devwatch.drop(name)  # every plane: columns die with the index
         g_stats.count("tenancy.park")
         log.info("parked tenant %s (%d MB device)", name, freed >> 20)
         return freed

@@ -1,0 +1,21 @@
+# osselint: path=open_source_search_engine_tpu/query/devindex.py
+# stats-cardinality clean counterpart: literal names, a module-level
+# lookup table over a bounded bucket set, and dynamic *values* (not
+# names) are all fine — the name space stays enumerable.
+
+_WAVE_STAT = {n: f"devindex.wave_n{n}" for n in (1, 2, 4, 8)}
+
+
+def _nbucket(n):
+    for b in (1, 2, 4, 8):
+        if n <= b:
+            return b
+    return 8
+
+
+def collect(waves, nbytes, g_stats, trace):
+    stat = _WAVE_STAT.get(_nbucket(len(waves)))
+    if stat is not None:
+        trace.record(stat, 0, 1)
+    g_stats.count("devindex.rounds")
+    g_stats.gauge("devindex.bytes", nbytes)  # dynamic value, fixed name

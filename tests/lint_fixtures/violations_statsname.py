@@ -1,0 +1,13 @@
+# osselint: path=open_source_search_engine_tpu/query/devindex.py
+# stats-cardinality fixture — metric names built at the call site.
+# The pragma re-scopes it to the query plane where the rule runs.
+# Each shape below mints one time series per distinct runtime value
+# (the devindex.wave_f1+f2_n5 class: a gauge per observed wave
+# count), which is unbounded dashboard cardinality.
+
+
+def collect(kinds, waves, route, nbytes, g_stats, trace):
+    trace.record(f"devindex.wave_{kinds}_n{len(waves)}", 0, 1)  # EXPECT stats-cardinality
+    g_stats.count("devindex.trip." + route)  # EXPECT stats-cardinality
+    g_stats.gauge("devindex.%s.bytes" % route, nbytes)  # EXPECT stats-cardinality
+    g_stats.record_ms("devindex.{}.ms".format(route), 2.0)  # EXPECT stats-cardinality

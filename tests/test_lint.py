@@ -165,6 +165,31 @@ class TestSeededRegressions:
         assert osselint.check_source(
             src, "open_source_search_engine_tpu/utils/stats.py") == []
 
+    def test_dynamic_stat_name_is_caught_and_table_fixes_it(self):
+        # the literal pre-telemetry devindex shape: one time series
+        # per observed wave count (devindex.wave_f1+f2_n5, _n7, ...)
+        src = ("def collect(kinds, waves, t0, t1):\n"
+               "    trace.record(\n"
+               "        f'devindex.wave_{kinds}_n{len(waves)}',"
+               " t0, t1)\n")
+        found = osselint.check_source(
+            src, "open_source_search_engine_tpu/query/devindex.py")
+        assert [f.rule for f in found] == ["stats-cardinality"]
+        # the fix: bucket the count, look the name up from a literal
+        # module-level table (f-strings OUTSIDE a stats call are fine)
+        fixed = ("_WAVE_STAT = {n: f'devindex.wave_n{n}'\n"
+                 "              for n in (1, 2, 4, 8)}\n"
+                 "def collect(kinds, waves, t0, t1):\n"
+                 "    stat = _WAVE_STAT.get(min(len(waves), 8))\n"
+                 "    if stat is not None:\n"
+                 "        trace.record(stat, t0, t1)\n")
+        assert osselint.check_source(
+            fixed,
+            "open_source_search_engine_tpu/query/devindex.py") == []
+        # the rule is scoped to the query plane
+        assert osselint.check_source(
+            src, "open_source_search_engine_tpu/serve/server.py") == []
+
     def test_adhoc_timing_on_query_path_is_caught(self):
         # the literal devindex/engine shape the metrics-plane PR
         # removed: a perf_counter delta feeding g_stats directly, so

@@ -16,7 +16,8 @@ python -m tools.osselint
 python -m tools.osselint tests/lint_fixtures/clean_parallel.py \
     tests/lint_fixtures/clean_jit.py tests/lint_fixtures/clean_mesh.py \
     tests/lint_fixtures/clean_tenancy.py \
-    tests/lint_fixtures/clean_devbuild.py
+    tests/lint_fixtures/clean_devbuild.py \
+    tests/lint_fixtures/clean_statsname.py
 for f in tests/lint_fixtures/violations_*.py; do
     if python -m tools.osselint "$f" > /dev/null 2>&1; then
         echo "check.sh: $f produced no findings" >&2
@@ -35,7 +36,7 @@ fi
 #    failover; the full soak gate stays behind `-m slow` / BENCH_SOAK=1
 JAX_PLATFORMS=cpu python -m pytest tests/test_lint.py \
     tests/test_jitwatch.py tests/test_query.py tests/test_chaos.py \
-    tests/test_statsplane.py \
+    tests/test_statsplane.py tests/test_devwatch.py \
     -q -m 'not slow' -p no:cacheprovider
 
 # 4. SLO gate: 2-node fleet, mergeable-histogram scrape, burn-rate
@@ -91,5 +92,17 @@ BENCH_MESH=1 BENCH_MESH_SHARDS=1,4 BENCH_MESH_DPS=80 \
 #    100k-doc < 60 s shape runs nightly via BENCH_BUILD=1 defaults)
 BENCH_BUILD=1 BENCH_BUILD_DOCS=400 BENCH_BUILD_PARITY_DOCS=200 \
     BENCH_BUILD_REBUILD_S=300 \
+    JAX_PLATFORMS=cpu python bench.py
+
+# 10. device-telemetry smoke: the backend doctor (rc=2 "no
+#     accelerator" is benign on CI boxes; rc=1 means a TPU host is
+#     misbehaving — init-failed or silent CPU fallback), then the
+#     devwatch gate — <2% steady-state overhead with the plane armed,
+#     HBM ledger == the index's own accounting (and memory_stats
+#     within 5% where the backend reports it), a roofline entry per
+#     dispatched shape bucket, the doctor stamp on the JSON line
+#     (bench.py main_devobs docstring)
+JAX_PLATFORMS=cpu python -m tools.devdoctor || [ $? -eq 2 ]
+BENCH_DEVOBS=1 BENCH_DEVOBS_DOCS=160 BENCH_DEVOBS_WAVES=40 \
     JAX_PLATFORMS=cpu python bench.py
 echo "check.sh: OK"

@@ -1,0 +1,192 @@
+"""devdoctor — backend preflight for the bench/serve planes.
+
+The r05 failure class: a bench run on a TPU host whose backend init
+died was silently retried onto the CPU backend, measured, and filed
+next to device-measured numbers — the ROADMAP grounding note still
+flags r04 as the last device-measured point because of exactly that.
+This probe makes the failure loud and machine-readable:
+
+* ``probe()`` initializes the backend with the same bounded
+  retry-with-backoff the bench uses, and records platform, device
+  kind/count, topology and ``memory_stats()`` (null where the backend
+  has none — CPU).
+* The verdict distinguishes the cases the harness kept conflating:
+  ``ok`` (accelerator up), ``no-accelerator`` (CPU box, CPU run —
+  benign), ``fallback`` (a TPU was expected — env says so — but jax
+  resolved CPU: the silent-fallback class, now exit 1), and
+  ``init-failed`` (backend init raised through every retry).
+* ``stamp()`` is the memoized record ``bench._backend_record()``
+  merges into EVERY BENCH_* JSON line, so curves spanning runs carry
+  the jax version, device kind/count and doctor verdict next to
+  ``device_measured``.
+
+CLI: ``python -m tools.devdoctor`` prints the probe JSON and exits
+0 (ok), 1 (init-failed / fallback — a TPU host is misbehaving),
+2 (no accelerator present — benign on CI boxes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+EXIT_OK = 0
+EXIT_INIT_FAILED = 1
+EXIT_NO_ACCEL = 2
+
+_stamp_cache: dict | None = None
+
+
+def tpu_expected() -> bool:
+    """Does the environment claim a TPU should be reachable? A CPU
+    resolution under these signals is the r05 silent-fallback class,
+    not a benign CPU run."""
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    if "tpu" in plat:
+        return True
+    if plat:  # explicitly forced elsewhere (cpu CI runs land here)
+        return False
+    if any(k.startswith(("TPU_", "LIBTPU")) for k in os.environ):
+        return True
+    try:
+        import libtpu  # noqa: F401
+        return True
+    except Exception:
+        return False
+
+
+def probe(max_tries: int = 3) -> dict:
+    """Initialize the backend (bounded retry-with-backoff, mirroring
+    bench._init_backend) and return the full diagnosis record."""
+    base = float(os.environ.get("BENCH_INIT_BACKOFF_S", "5"))
+    expected = tpu_expected()
+    err: Exception | None = None
+    jax = None
+    for attempt in range(max_tries):
+        try:
+            import jax as _jax
+            _jax.devices()  # forces backend client init
+            jax, err = _jax, None
+            break
+        except Exception as e:  # noqa: BLE001 — any init failure
+            err = e
+            try:  # drop the poisoned client so the retry re-inits
+                from jax.extend import backend as _jxb
+                _jxb.clear_backends()
+            except Exception as ce:  # noqa: BLE001
+                print(f"# devdoctor: clear_backends failed: {ce!r}",
+                      file=sys.stderr)
+            if attempt + 1 < max_tries:
+                time.sleep(base * (2 ** attempt))
+    rec: dict = {"tpu_expected": expected,
+                 "error": repr(err)[:300] if err is not None else None}
+    if jax is None:
+        rec.update({"status": "init-failed", "platform": None,
+                    "jax_version": None, "device_kind": None,
+                    "device_count": 0, "topology": None,
+                    "memory_stats": None})
+        return rec
+    devs = jax.devices()
+    d0 = devs[0]
+    try:
+        ms = d0.memory_stats()
+    except Exception:
+        ms = None
+    platform = str(jax.default_backend())
+    if platform != "cpu":
+        status = "ok"
+    elif expected:
+        status = "fallback"   # the r05 class: TPU host, CPU backend
+    else:
+        status = "no-accelerator"
+    rec.update({
+        "status": status,
+        "platform": platform,
+        "jax_version": jax.__version__,
+        "device_kind": str(getattr(d0, "device_kind", "unknown")),
+        "device_count": len(devs),
+        "topology": {
+            "process_count": int(jax.process_count()),
+            "devices": [str(d) for d in devs[:16]],
+            "coords": [list(getattr(d, "coords", ()) or ())
+                       for d in devs[:16]],
+        },
+        "memory_stats": ({k: int(v) for k, v in ms.items()}
+                         if ms else None),
+    })
+    return rec
+
+
+def stamp() -> dict:
+    """The memoized per-process backend stamp bench merges into every
+    BENCH_* JSON line. Keys are chosen not to collide with the
+    existing ``backend`` / ``device_measured`` fields."""
+    global _stamp_cache
+    if _stamp_cache is None:
+        rec = probe(max_tries=int(os.environ.get("BENCH_INIT_TRIES",
+                                                 "3")))
+        _stamp_cache = {
+            "doctor": rec["status"],
+            "jax_version": rec["jax_version"],
+            "device_kind": rec["device_kind"],
+            "device_count": rec["device_count"],
+            "topology": rec["topology"],
+            "memory_stats": rec["memory_stats"],
+        }
+    return dict(_stamp_cache)
+
+
+def diagnose(rec: dict) -> str:
+    """One actionable paragraph per failure class — what r05 needed
+    instead of a silent CPU point."""
+    s = rec["status"]
+    if s == "ok":
+        return (f"backend ok: {rec['platform']} × "
+                f"{rec['device_count']} ({rec['device_kind']})")
+    if s == "no-accelerator":
+        return ("no accelerator present and none expected — CPU "
+                "numbers are host-measured, device_measured stays "
+                "false")
+    if s == "fallback":
+        return ("TPU expected (JAX_PLATFORMS/TPU_*/libtpu say so) but "
+                "jax resolved the CPU backend — the r05 silent-"
+                "fallback class. Check that libtpu matches the jax "
+                "version, that no other process holds the TPU "
+                "(/dev/accel* busy), and that JAX_PLATFORMS is not "
+                "forcing cpu; numbers measured now would be "
+                "mislabeled host points.")
+    return (f"backend init raised through every retry: {rec['error']} "
+            "— check the TPU runtime/tunnel is up (the r05 wedge), "
+            "raise BENCH_INIT_BACKOFF_S if the client races runtime "
+            "start, or force JAX_PLATFORMS=cpu for an explicit "
+            "host-measured run.")
+
+
+def preflight() -> dict:
+    """Bench entry: probe once (shares the stamp cache), print the
+    diagnosis to stderr, return the record. Never raises — the legs
+    decide what to gate on."""
+    try:
+        rec = probe(max_tries=int(os.environ.get("BENCH_INIT_TRIES",
+                                                 "3")))
+    except Exception as e:  # noqa: BLE001 — diagnosis must not wedge
+        rec = {"status": "init-failed", "error": repr(e)[:300]}
+    print(f"# devdoctor: {diagnose(rec)}", file=sys.stderr)
+    return rec
+
+
+def main() -> int:
+    rec = probe(max_tries=int(os.environ.get("BENCH_INIT_TRIES", "3")))
+    print(json.dumps(rec, indent=2))
+    print(f"# {diagnose(rec)}", file=sys.stderr)
+    if rec["status"] in ("init-failed", "fallback"):
+        return EXIT_INIT_FAILED
+    if rec["status"] == "no-accelerator":
+        return EXIT_NO_ACCEL
+    return EXIT_OK
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -292,6 +292,53 @@ def _timed_scope(rel: str) -> bool:
                for d in ("query", "parallel", "serve"))
 
 
+#: stats/trace entry points whose first positional argument is a
+#: metric name — a dynamically built name there mints a new time
+#: series per distinct value (the devindex.wave_f1+f2_n5 class:
+#: one gauge per observed wave count, unbounded dashboards).
+_STATS_NAME_FUNCS = {
+    "g_stats.count", "g_stats.gauge", "g_stats.record_ms",
+    "g_stats.timed", "trace.record", "trace.timed_span",
+    "trace_mod.record", "trace_mod.timed_span",
+}
+
+
+def rule_stats_cardinality(ctx: Ctx) -> list[Finding]:
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and dotted(node.func) in _STATS_NAME_FUNCS
+                and node.args):
+            continue
+        arg = node.args[0]
+        dyn = None
+        if isinstance(arg, ast.JoinedStr) and any(
+                isinstance(v, ast.FormattedValue) for v in arg.values):
+            dyn = "an f-string"
+        elif isinstance(arg, ast.Call) \
+                and isinstance(arg.func, ast.Attribute) \
+                and arg.func.attr == "format":
+            dyn = ".format()"
+        elif isinstance(arg, ast.BinOp) \
+                and isinstance(arg.op, ast.Mod):
+            dyn = "%-formatting"
+        elif isinstance(arg, ast.BinOp) \
+                and isinstance(arg.op, ast.Add):
+            dyn = "concatenation"
+        if dyn:
+            out.append(Finding(
+                ctx.rel, node.lineno, "stats-cardinality",
+                f"stat name built with {dyn} — every distinct value "
+                "mints a new time series (unbounded cardinality); "
+                "bucket the variable and look the name up from a "
+                "module-level literal table"))
+    return out
+
+
+def _stats_name_scope(rel: str) -> bool:
+    return rel.startswith(f"{PKG}/query/")
+
+
 def rule_id_key(ctx: Ctx) -> list[Finding]:
     out = []
     for node in ast.walk(ctx.tree):
@@ -1215,6 +1262,7 @@ RULES = [
     ("ttlcache-offplane", _ttl_scope, rule_ttlcache_offplane),
     ("urllib-in-parallel", _urllib_scope, rule_urllib_in_parallel),
     ("bare-stats-timed", _timed_scope, rule_bare_stats_timed),
+    ("stats-cardinality", _stats_name_scope, rule_stats_cardinality),
     ("id-key", _in_pkg, rule_id_key),
     ("blocking-under-lock", _in_pkg, rule_blocking_under_lock),
     ("silent-except", _scope_pkg_tools, rule_silent_except),
