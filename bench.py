@@ -2494,6 +2494,52 @@ def main_tenants() -> dict:
     return rep
 
 
+def main_sched() -> dict:
+    """Concurrency gate (BENCH_SCHED=1): deep schedule exploration of
+    the five protocol scenario suites — BENCH_SCHED_SCHEDULES seeded
+    interleavings each (default 1024, vs check.sh's 64) at the
+    configured preemption bound. schedcheck arms at import from
+    OSSE_SCHED=1, so when the env var is missing this re-execs itself
+    with it set rather than silently exploring nothing.
+
+    Exits 1 on ANY schedule failure; the failing seed + shrunk
+    preemption trace goes to stderr so the exact interleaving can be
+    replayed. Prints ONE JSON line."""
+    if os.environ.get("OSSE_SCHED") != "1":
+        env = dict(os.environ, OSSE_SCHED="1")
+        os.execve(sys.executable,
+                  [sys.executable, os.path.abspath(__file__)], env)
+    from open_source_search_engine_tpu.utils import schedcheck
+    from tests import sched_scenarios
+
+    n = int(os.environ.get("BENCH_SCHED_SCHEDULES", "1024"))
+    bound = int(os.environ.get("OSSE_SCHED_PREEMPTIONS", "3"))
+    t0 = time.monotonic()
+    suites, ok = {}, True
+    for name in sorted(sched_scenarios.SCENARIOS):
+        fn = sched_scenarios.SCENARIOS[name]
+        try:
+            out = schedcheck.explore(fn, schedules=n,
+                                     preemption_bound=bound)
+            suites[name] = {"ok": True,
+                            "yield_points": out["yield_points"]}
+        except schedcheck.ScheduleFailure as f:
+            ok = False
+            suites[name] = {"ok": False, "seed": f.seed,
+                            "error": str(f.error)}
+            print(f"[sched] {name}:\n{f}", file=sys.stderr)
+    rep = {
+        "metric": "sched_gate", "value": n, "unit": "schedules",
+        "ok": ok, "suites": suites,
+        "schedules_explored": n * len(suites),
+        "preemption_bound": bound,
+        "elapsed_s": round(time.monotonic() - t0, 2),
+    }
+    rep.update(_backend_record())
+    print(json.dumps(rep))
+    return rep
+
+
 if __name__ == "__main__":
     if not os.environ.get("BENCH_MESH_CHILD"):
         # backend preflight: loud, actionable diagnosis on stderr for
@@ -2531,5 +2577,7 @@ if __name__ == "__main__":
         sys.exit(0 if main_tenants()["ok"] else 1)
     elif os.environ.get("BENCH_DEVOBS"):
         sys.exit(0 if main_devobs()["ok"] else 1)
+    elif os.environ.get("BENCH_SCHED"):
+        sys.exit(0 if main_sched()["ok"] else 1)
     else:
         main()

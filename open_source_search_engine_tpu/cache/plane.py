@@ -43,14 +43,13 @@ put is dropped) — the A/B switch the cache bench and cluster client use.
 from __future__ import annotations
 
 import os
-import threading
 import time
 from typing import Any, Callable, Hashable
 
 import numpy as np
 
 from ..utils import threads, trace as trace_mod
-from ..utils.lockcheck import make_lock
+from ..utils.lockcheck import make_event, make_lock
 from ..utils.log import get_logger
 from ..utils.membudget import g_membudget
 from ..utils.stats import g_stats
@@ -103,7 +102,7 @@ class _Flight:
     __slots__ = ("event", "value", "err", "gen")
 
     def __init__(self, gen: Any = None):
-        self.event = threading.Event()
+        self.event = make_event("cache.flight")
         self.value: Any = None
         self.err: BaseException | None = None
         self.gen = gen

@@ -338,7 +338,7 @@ class ShardNodeServer:
                          "content": payload["content"]})
                 ml = docproc.index_document(
                     self.coll, payload["url"], payload["content"])
-                self._maybe_checkpoint()
+                self._maybe_checkpoint_locked()
                 if ml is None:  # tagdb manualban — the DELIVERY
                     # succeeded (ok), the document was refused; ok=False
                     # would park the write and wedge the ordered queue
@@ -604,7 +604,7 @@ class ShardNodeServer:
             self._journal.truncate()
             self._writes_since_save = 0
 
-    def _maybe_checkpoint(self) -> None:
+    def _maybe_checkpoint_locked(self) -> None:
         """Bound journal growth/replay cost: checkpoint every few
         hundred acked writes (caller holds the writer lock)."""
         self._writes_since_save += 1

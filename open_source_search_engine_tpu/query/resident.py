@@ -42,7 +42,6 @@ from the surviving twin, and no ticket is ever lost to a failover.
 
 from __future__ import annotations
 
-import threading
 from collections import deque
 from typing import Callable
 
@@ -50,6 +49,7 @@ from ..utils import deadline as deadline_mod
 from ..utils import devwatch
 from ..utils import threads as _threads
 from ..utils.chaos import g_chaos
+from ..utils.lockcheck import make_condition, make_event
 from ..utils.log import get_logger
 from ..utils.membudget import g_membudget
 from ..utils.priority import QueueFull
@@ -91,7 +91,7 @@ class Ticket:
         self.deadline = deadline
         self.di = None
         self.generation: int | None = None
-        self._ev = threading.Event()
+        self._ev = make_event("resident.ticket")
         self._res = None
         self._err: BaseException | None = None
 
@@ -144,7 +144,7 @@ class ResidentLoop:
         self.name = name
         self._max_batch = max_batch
         self._max_queue = max_queue
-        self._cv = threading.Condition()
+        self._cv = make_condition("resident.cv")
         self._queue: deque[Ticket] = deque()
         self._inflight: deque[_Wave] = deque()
         self._alive = True

@@ -68,12 +68,17 @@ class Speller:
     # --- suggestion (Speller::getRecommendation flow) ---
 
     def _by_len(self) -> dict[int, set[str]]:
-        if self._len_index is None:
-            ix: dict[int, set[str]] = defaultdict(set)
+        # read once, build into a local, publish once: a concurrent
+        # add_doc_words() invalidation can no longer land between the
+        # None check and a re-read (we'd return None); worst case two
+        # threads build identical indexes and one wins
+        ix = self._len_index
+        if ix is None:
+            ix = defaultdict(set)
             for w in self.counts:
                 ix[len(w)].add(w)
             self._len_index = ix
-        return self._len_index
+        return ix
 
     def suggest_word(self, word: str) -> str | None:
         word = word.lower()

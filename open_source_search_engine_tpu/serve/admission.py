@@ -43,12 +43,12 @@ importing the serve layer.
 from __future__ import annotations
 
 import contextvars
-import threading
 import time
 from collections import deque
 
 from ..utils import deadline as deadline_mod
 from ..utils import trace as trace_mod
+from ..utils.lockcheck import make_condition
 from ..utils.membudget import g_membudget
 from ..utils.priority import TIERS
 from ..utils.slo import g_slo
@@ -107,7 +107,7 @@ class AdmissionGate:
         #: the live SLO burn-rate and membudget headroom planes)
         self._degraded_fn = degraded_fn or (lambda: g_slo.degraded())
         self._pressure_fn = pressure_fn or self._mem_pressure
-        self._cv = threading.Condition()
+        self._cv = make_condition("admission.cv")
         self._inflight = 0
         self._draining = False
         self._waiting: dict[str, deque] = {t: deque() for t in TIERS}

@@ -994,11 +994,14 @@ class SearchHTTPServer:
         """REST crawl jobs (PageCrawlBot.cpp): create/status/pause/
         resume/delete; corpora search via /search?c=crawl_<name>."""
         from .crawlbot import CrawlBot
-        if self._crawlbot is None:
-            self._crawlbot = CrawlBot(self.colldb,
-                                      fetcher_factory=
-                                      self.crawl_fetcher_factory)
-        bot = self._crawlbot
+        # two concurrent first requests must not each build a CrawlBot
+        # (the loser's job state would be dropped on publish)
+        with self._lock:
+            if self._crawlbot is None:
+                self._crawlbot = CrawlBot(self.colldb,
+                                          fetcher_factory=
+                                          self.crawl_fetcher_factory)
+            bot = self._crawlbot
         name = query.get("name", "")
         if not name:
             return 200, json.dumps({"jobs": bot.list_jobs()}),                 "application/json"

@@ -44,13 +44,13 @@ collections against the gates in the ROADMAP item.
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import deque
 
 from ..utils import deadline as deadline_mod
 from ..utils import devwatch
 from ..utils import trace as trace_mod
+from ..utils.lockcheck import make_event, make_rlock
 from ..utils.log import get_logger
 from ..utils.membudget import g_membudget
 from ..utils.stats import g_stats
@@ -92,7 +92,7 @@ class _Flight:
     __slots__ = ("ev", "loop", "err")
 
     def __init__(self):
-        self.ev = threading.Event()
+        self.ev = make_event("tenancy.flight")
         self.loop = None
         self.err: BaseException | None = None
 
@@ -104,7 +104,7 @@ class ResidencyManager:
         #: count bound on the resident set; 0 = unbounded (the byte
         #: bound is the membudget "device" label cap, set separately)
         self.max_resident = int(max_resident)
-        self._lock = threading.RLock()
+        self._lock = make_rlock("tenancy.manager")
         self._tenants: dict[str, _Tenant] = {}
         self._flights: dict[str, _Flight] = {}
         self._seq = 0

@@ -37,6 +37,7 @@ import time
 import traceback
 from typing import Any
 
+from . import schedcheck as _schedcheck
 from .log import get_logger
 from .stats import g_stats
 
@@ -78,6 +79,10 @@ class LockCheckRegistry:
         self.edge_info: dict[tuple[str, str], str] = {}
         #: cycle paths ([name, ..., name]) — potential deadlocks
         self.cycles: list[list[str]] = []
+        #: per cycle, "src->dst" → "thread | stack" for EVERY edge on
+        #: the loop (both acquisition orders of a 2-cycle), so a
+        #: schedcheck failure timeline cross-references by lock name
+        self.cycle_stacks: list[dict[str, str]] = []
         #: blocking-call-under-lock events
         self.blocking: list[dict] = []
 
@@ -127,6 +132,10 @@ class LockCheckRegistry:
                     if back is not None:
                         cycle = back + [dst]
                         self.cycles.append(cycle)
+                        pairs = list(zip(cycle, cycle[1:]))
+                        self.cycle_stacks.append(
+                            {f"{a}->{b}": self.edge_info.get((a, b), "?")
+                             for a, b in pairs})
                         g_stats.count("lockcheck.cycle")
                         log.error(
                             "lock-order cycle (potential deadlock): "
@@ -168,6 +177,7 @@ class LockCheckRegistry:
                 "edge_info": {f"{s}->{d}": v for (s, d), v in
                               self.edge_info.items()},
                 "cycles": [list(c) for c in self.cycles],
+                "cycle_stacks": [dict(s) for s in self.cycle_stacks],
                 "blocking": list(self.blocking),
             }
 
@@ -176,6 +186,7 @@ class LockCheckRegistry:
             self.edges.clear()
             self.edge_info.clear()
             self.cycles.clear()
+            self.cycle_stacks.clear()
             self.blocking.clear()
 
 
@@ -201,7 +212,15 @@ class TrackedLock:
 
     def acquire(self, blocking: bool = True,
                 timeout: float = -1) -> bool:
-        got = self._inner.acquire(blocking, timeout)
+        # try-then-block so contention is observable: a failed fast
+        # acquire counts ``lock.<name>.contended`` before parking —
+        # with held_ms it answers "which mutex is the ceiling AND who
+        # queues on it"
+        got = self._inner.acquire(False)
+        if not got:
+            g_stats.count(f"lock.{self.name}.contended")
+            if blocking:
+                got = self._inner.acquire(True, timeout)
         if got:
             self.registry.note_acquire(self.name)
         return got
@@ -235,7 +254,11 @@ class TrackedRLock(TrackedLock):
 
     def acquire(self, blocking: bool = True,
                 timeout: float = -1) -> bool:
-        got = self._inner.acquire(blocking, timeout)
+        got = self._inner.acquire(False)
+        if not got:
+            g_stats.count(f"lock.{self.name}.contended")
+            if blocking:
+                got = self._inner.acquire(True, timeout)
         if got:
             d = getattr(self._depth, "n", 0)
             self._depth.n = d + 1
@@ -254,12 +277,45 @@ class TrackedRLock(TrackedLock):
 def make_lock(name: str):
     """A mutex for the hot-lock roster: plain ``threading.Lock`` when
     the auditor is off (zero overhead), :class:`TrackedLock` under
-    ``OSSE_LOCKCHECK=1``."""
+    ``OSSE_LOCKCHECK=1``, and a cooperatively scheduled lock when the
+    calling thread is inside an active ``schedcheck.explore``."""
+    if _schedcheck._active is not None:
+        sched = _schedcheck.maybe_lock(name)
+        if sched is not None:
+            return sched
     return TrackedLock(name) if ENABLED else threading.Lock()
 
 
 def make_rlock(name: str):
+    if _schedcheck._active is not None:
+        sched = _schedcheck.maybe_rlock(name)
+        if sched is not None:
+            return sched
     return TrackedRLock(name) if ENABLED else threading.RLock()
+
+
+def make_condition(name: str):
+    """A condition variable for the hot-lock roster. Under
+    ``OSSE_LOCKCHECK=1`` the inner lock is tracked (wait/notify hold
+    times and ordering edges land under ``name``); under an active
+    schedcheck exploration it is a scheduled condition."""
+    if _schedcheck._active is not None:
+        sched = _schedcheck.maybe_condition(name)
+        if sched is not None:
+            return sched
+    if ENABLED:
+        return threading.Condition(TrackedLock(name))
+    return threading.Condition()
+
+
+def make_event(name: str):
+    """An event for the roster — plain off-exploration (events carry no
+    lock-ordering information), scheduled inside one."""
+    if _schedcheck._active is not None:
+        sched = _schedcheck.maybe_event(name)
+        if sched is not None:
+            return sched
+    return threading.Event()
 
 
 # --- blocking-call probes ---------------------------------------------------

@@ -19,12 +19,19 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable
 
+from . import schedcheck as _schedcheck
+
 
 def make_thread(name: str, target: Callable[..., Any], *args: Any,
                 **kwargs: Any) -> threading.Thread:
     """A named daemon thread, NOT started (callers that must publish
     the Thread object before it runs — batch workers whose loop checks
-    ``self._thread``)."""
+    ``self._thread``). Inside an active ``schedcheck.explore`` the
+    thread is a cooperatively scheduled one."""
+    if _schedcheck._active is not None:
+        sched = _schedcheck.maybe_thread(name, target, args, kwargs)
+        if sched is not None:
+            return sched
     return threading.Thread(target=target, args=args, kwargs=kwargs,
                             daemon=True, name=name)
 

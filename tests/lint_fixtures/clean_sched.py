@@ -1,0 +1,41 @@
+# osselint: path=open_source_search_engine_tpu/serve/fixture_sched.py
+# clean counterpart to violations_sched.py: every shared write under
+# the owning lock, check and act inside one critical section, waits in
+# predicate loops, plus the repo's *_locked caller-holds-lock naming
+# convention (admission.py style).
+import threading
+
+
+class Gate:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._grants = {}
+        self._inflight = 0
+
+    def admit(self, key):
+        with self._lock:
+            self._grant_locked(key)
+
+    def release(self, key):
+        with self._lock:
+            self._inflight -= 1
+            self._grants.pop(key, None)
+            self._cv.notify_all()
+
+    def _grant_locked(self, key):
+        # caller holds self._lock (naming convention) — writes here
+        # count as protected
+        self._inflight += 1
+        self._grants[key] = True
+
+    def lazy(self, key):
+        with self._lock:
+            if key not in self._grants:
+                self._grants[key] = object()
+            return self._grants[key]
+
+    def wait_done(self):
+        with self._cv:
+            while self._inflight:
+                self._cv.wait(1.0)

@@ -1,0 +1,38 @@
+# osselint: path=open_source_search_engine_tpu/serve/fixture_sched.py
+# concurrency fixture — the pragma re-scopes it to the serve plane,
+# where the schedcheck static rules apply. Each "EXPECT rule" comment
+# marks the line a finding must anchor to. Never scanned by the real
+# linter (lint_fixtures/ is excluded from directory walks).
+import threading
+
+
+class Gate:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._grants = {}
+        self._inflight = 0
+
+    def admit(self, key):
+        with self._lock:
+            self._inflight += 1
+            self._grants[key] = True
+
+    def release(self, key):
+        # same counter admit() guards — the lost-update interleaving
+        self._inflight -= 1  # EXPECT shared-state-unlocked
+
+    def lazy(self, key):
+        if key not in self._grants:
+            self._grants[key] = object()  # EXPECT check-then-act
+        return self._grants[key]
+
+    def wait_one(self):
+        with self._cv:
+            self._cv.wait(1.0)  # EXPECT cond-wait-no-loop
+
+    def wait_right(self):
+        # predicate loop: re-checks after every wakeup — clean
+        with self._cv:
+            while not self._grants:
+                self._cv.wait(1.0)

@@ -18,6 +18,20 @@ from open_source_search_engine_tpu.serve.server import (QueryBatcher,
 from open_source_search_engine_tpu.utils.parms import Conf
 
 
+@pytest.fixture(autouse=True)
+def _reset_slo():
+    """The server's request handling feeds the process-global SLO
+    tracker; a slow CI box can leave query_p99 burning, and the
+    NEXT test file's AdmissionGate (default degraded_fn reads
+    g_slo.degraded()) would then shed background tiers with reason
+    "signal" — cross-file pollution schedcheck's admission suite
+    exists to catch. Scrub the signal both ways."""
+    from open_source_search_engine_tpu.utils.slo import g_slo
+    g_slo.reset()
+    yield
+    g_slo.reset()
+
+
 @pytest.fixture
 def srv(tmp_path):
     s = SearchHTTPServer(tmp_path, port=0)

@@ -227,4 +227,50 @@ class TestFactoryGating:
                 pass
         reg.reset()
         assert reg.report() == {"edges": {}, "edge_info": {},
-                                "cycles": [], "blocking": []}
+                                "cycles": [], "cycle_stacks": [],
+                                "blocking": []}
+
+
+class TestContentionAndCycleStacks:
+    def test_contended_acquire_counts_stat(self, reg):
+        """A blocked acquire bumps ``lock.<name>.contended`` — the
+        telemetry that says WHICH lock serializes the fleet."""
+        name = "lockcheck-test-contend"
+        key = f"lock.{name}.contended"
+        lk = TrackedLock(name, reg)
+        before = g_stats.snapshot()["counters"].get(key, 0)
+        lk.acquire()
+        t = threading.Thread(target=lambda: (lk.acquire(), lk.release()),
+                             daemon=True)
+        t.start()
+        time.sleep(0.02)  # let the thread block on the held lock
+        lk.release()
+        t.join(timeout=5)
+        assert g_stats.snapshot()["counters"][key] == before + 1
+
+    def test_uncontended_acquire_does_not_count(self, reg):
+        name = "lockcheck-test-uncontend"
+        key = f"lock.{name}.contended"
+        before = g_stats.snapshot()["counters"].get(key, 0)
+        lk = TrackedLock(name, reg)
+        with lk:
+            pass
+        assert g_stats.snapshot()["counters"].get(key, 0) == before
+
+    def test_cycle_report_carries_both_acquisition_stacks(self, reg):
+        """The DFS cycle report names where EACH edge of the inversion
+        was taken — both sides of the A→B / B→A pair."""
+        a = TrackedLock("A", reg)
+        b = TrackedLock("B", reg)
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert len(reg.cycle_stacks) == 1
+        stacks = reg.cycle_stacks[0]
+        assert set(stacks) == {"A->B", "B->A"}
+        me = threading.current_thread().name
+        assert all(me in where for where in stacks.values())
+        assert reg.report()["cycle_stacks"] == [stacks]

@@ -17,7 +17,8 @@ python -m tools.osselint tests/lint_fixtures/clean_parallel.py \
     tests/lint_fixtures/clean_jit.py tests/lint_fixtures/clean_mesh.py \
     tests/lint_fixtures/clean_tenancy.py \
     tests/lint_fixtures/clean_devbuild.py \
-    tests/lint_fixtures/clean_statsname.py
+    tests/lint_fixtures/clean_statsname.py \
+    tests/lint_fixtures/clean_sched.py
 for f in tests/lint_fixtures/violations_*.py; do
     if python -m tools.osselint "$f" > /dev/null 2>&1; then
         echo "check.sh: $f produced no findings" >&2
@@ -37,6 +38,15 @@ fi
 JAX_PLATFORMS=cpu python -m pytest tests/test_lint.py \
     tests/test_jitwatch.py tests/test_query.py tests/test_chaos.py \
     tests/test_statsplane.py tests/test_devwatch.py \
+    tests/test_schedcheck.py \
+    -q -m 'not slow' -p no:cacheprovider
+
+# 3b. schedule exploration: the five protocol scenario suites plus the
+#     seeded historical-bug regressions under the armed explorer — 64
+#     seeded interleavings per suite, deterministic and replayable
+#     (the 1024-schedule deep run lives behind BENCH_SCHED=1 / -m slow)
+OSSE_SCHED=1 OSSE_SCHED_BUDGET=64 JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_schedcheck.py \
     -q -m 'not slow' -p no:cacheprovider
 
 # 4. SLO gate: 2-node fleet, mergeable-histogram scrape, burn-rate
@@ -104,5 +114,12 @@ BENCH_BUILD=1 BENCH_BUILD_DOCS=400 BENCH_BUILD_PARITY_DOCS=200 \
 #     (bench.py main_devobs docstring)
 JAX_PLATFORMS=cpu python -m tools.devdoctor || [ $? -eq 2 ]
 BENCH_DEVOBS=1 BENCH_DEVOBS_DOCS=160 BENCH_DEVOBS_WAVES=40 \
+    JAX_PLATFORMS=cpu python bench.py
+
+# 11. concurrency smoke: the schedule-exploration gate at a SHORT
+#     budget (the nightly deep run uses the 1024-schedule default) —
+#     exits nonzero on any schedule failure, printing the failing seed
+#     and shrunk preemption trace (bench.py main_sched docstring)
+BENCH_SCHED=1 BENCH_SCHED_SCHEDULES=64 \
     JAX_PLATFORMS=cpu python bench.py
 echo "check.sh: OK"

@@ -1257,6 +1257,191 @@ def rule_admission_bypass(ctx: Ctx) -> list[Finding]:
     return out
 
 
+def _conc_scope(rel: str) -> bool:
+    """The planes whose objects real threads share — the schedcheck
+    scenario surface: query/, serve/, parallel/, cache/."""
+    return any(rel.startswith(f"{PKG}/{d}/")
+               for d in ("query", "serve", "parallel", "cache"))
+
+
+#: constructor-shaped methods whose writes happen before the object is
+#: published to other threads (dataclasses run __post_init__ inside
+#: generated __init__)
+_PREPUB = ("__init__", "__post_init__")
+
+
+def _locked_method(fn: ast.AST) -> bool:
+    """The repo's caller-holds-the-lock conventions: ``*_locked``
+    method names (admission.py) and locked-ish decorators (rdblite's
+    ``@_locked``) mean the lock is held on entry — writes inside are
+    protected even without a lexical ``with``."""
+    if fn.name.endswith("_locked"):
+        return True
+    return any((_final_ident(d) or "").endswith("locked")
+               for d in fn.decorator_list)
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """``x`` for a ``self.x`` expression, else None."""
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _body_stmts(body: list[ast.stmt]):
+    """Every node lexically in ``body``, NOT descending into nested
+    function/lambda definitions (closures run later, elsewhere)."""
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def rule_shared_state_unlocked(ctx: Ctx) -> list[Finding]:
+    """Per class: a ``self.``-attribute written under a lock in one
+    method (lexical ``with <lockish>:``, a ``*_locked`` name, or a
+    locked decorator) but without one in another. That split is the
+    lost-update shape schedcheck's explorer demonstrates dynamically —
+    two writers interleaving between read and write. ``__init__``
+    writes are pre-publication and exempt both ways."""
+    out = []
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods = [n for n in cls.body if isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        #: (attr, write node, method name, lock held)
+        writes: list[tuple[str, ast.AST, str, bool]] = []
+        for m in methods:
+            if m.name in _PREPUB:
+                continue
+            held = _locked_method(m)
+            for node in ast.walk(m):
+                if not isinstance(node, (ast.Assign, ast.AugAssign)):
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        writes.append((attr, node, m.name,
+                                       held or _under_lock(ctx, node)))
+        locked_in: dict[str, set[str]] = {}
+        for attr, _node, mname, prot in writes:
+            if prot:
+                locked_in.setdefault(attr, set()).add(mname)
+        seen: set[tuple[str, int]] = set()
+        for attr, node, mname, prot in writes:
+            if prot:
+                continue
+            others = locked_in.get(attr, set()) - {mname}
+            if not others or (attr, node.lineno) in seen:
+                continue
+            seen.add((attr, node.lineno))
+            out.append(Finding(
+                ctx.rel, node.lineno, "shared-state-unlocked",
+                f"self.{attr} written without a lock here but under "
+                f"one in {sorted(others)[0]}() — a thread can "
+                "interleave between the two writers (the lost-update "
+                "shape schedcheck explores); take the same lock"))
+    return out
+
+
+def rule_check_then_act(ctx: Ctx) -> list[Finding]:
+    """``if k in self.d:`` / ``if self.x is None:`` followed by a
+    mutation of the SAME shared container/attribute, outside any lock
+    body: the classic TOCTOU — another thread can act between the
+    check and the act. Lock-holding conventions (``with <lockish>:``,
+    ``*_locked`` names, locked decorators) exempt the site."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.If):
+            continue
+        fn = _enclosing_function(ctx, node)
+        if fn is None or fn.name in _PREPUB or _locked_method(fn):
+            continue
+        if _under_lock(ctx, node):
+            continue
+        test, attr, shape = node.test, None, None
+        if isinstance(test, ast.Compare) and len(test.ops) == 1:
+            op = test.ops[0]
+            if isinstance(op, (ast.In, ast.NotIn)):
+                attr = _self_attr(test.comparators[0])
+                shape = "membership"
+            elif isinstance(op, (ast.Is, ast.IsNot)) \
+                    and isinstance(test.comparators[0], ast.Constant) \
+                    and test.comparators[0].value is None:
+                attr = _self_attr(test.left)
+                shape = "none"
+        if attr is None:
+            continue
+        for sub in _body_stmts(node.body):
+            hit = False
+            if isinstance(sub, (ast.Assign, ast.AugAssign)):
+                targets = sub.targets if isinstance(sub, ast.Assign) \
+                    else [sub.target]
+                for t in targets:
+                    if isinstance(t, ast.Subscript) \
+                            and _self_attr(t.value) == attr:
+                        hit = True
+                    elif shape == "none" and _self_attr(t) == attr:
+                        hit = True
+            elif isinstance(sub, ast.Delete):
+                hit = any(isinstance(t, ast.Subscript)
+                          and _self_attr(t.value) == attr
+                          for t in sub.targets)
+            elif isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in _MUTATORS \
+                    and _self_attr(sub.func.value) == attr:
+                hit = True
+            if hit:
+                out.append(Finding(
+                    ctx.rel, sub.lineno, "check-then-act",
+                    f"self.{attr} checked then mutated without a lock "
+                    "— another thread can act between the check and "
+                    "this write (TOCTOU); hold the owning lock across "
+                    "both"))
+                break
+    return out
+
+
+def rule_cond_wait_no_loop(ctx: Ctx) -> list[Finding]:
+    """``Condition.wait`` not inside a ``while`` predicate loop.
+    Spurious wakeups and notify_all herds make a bare ``wait()`` (or
+    an ``if``-guarded one) return with the predicate false; every wait
+    must re-check in a loop — the shape schedcheck's notify scheduling
+    exercises directly."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute) \
+                or node.func.attr != "wait" \
+                or not _is_lockish(node.func.value):
+            continue
+        in_while = False
+        for _child, parent in ctx.ancestors(node):
+            if isinstance(parent, ast.While):
+                in_while = True
+                break
+            if isinstance(parent, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.Lambda)):
+                break
+        if not in_while:
+            out.append(Finding(
+                ctx.rel, node.lineno, "cond-wait-no-loop",
+                "Condition.wait outside a while predicate loop — "
+                "spurious wakeups / notify_all herds return with the "
+                "predicate false; wrap in `while not <predicate>:`"))
+    return out
+
+
 #: (rule-name, path predicate, checker)
 RULES = [
     ("ttlcache-offplane", _ttl_scope, rule_ttlcache_offplane),
@@ -1283,6 +1468,9 @@ RULES = [
     ("admission-bypass", _admission_scope, rule_admission_bypass),
     ("proc-spawn", _proc_scope, rule_proc_spawn),
     ("residency-bypass", _residency_scope, rule_residency_bypass),
+    ("shared-state-unlocked", _conc_scope, rule_shared_state_unlocked),
+    ("check-then-act", _conc_scope, rule_check_then_act),
+    ("cond-wait-no-loop", _in_pkg, rule_cond_wait_no_loop),
 ]
 
 RULE_NAMES = {name for name, _p, _c in RULES}
